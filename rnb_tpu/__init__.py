@@ -1,0 +1,29 @@
+"""rnb_tpu — a TPU-native streaming video-analytics inference framework.
+
+A ground-up JAX/XLA re-design of the capabilities of snuspl/rnb (the
+"Replicate & Batch" multi-GPU video inference benchmark): a client emits
+video requests at Poisson intervals into a configurable multi-stage
+pipeline (decode -> neural net stages -> aggregation) with replication,
+partitioning, segmentation, dynamic batching and content-aware routing —
+except that stages here map onto TPU-core sub-meshes inside a single
+controller process, stage hand-off is device-to-device transfer between
+shardings, and all model compute is jit-compiled XLA with static shapes.
+
+Architecture differences vs the reference (see SURVEY.md):
+  * one controller process + one Python thread per runner instance
+    (JAX async dispatch provides concurrency; the reference used one OS
+    process + private CUDA stream per GPU, reference runner.py:41-44)
+  * immutable device arrays handed through channels (the reference used
+    mutable shared CUDA tensors + CUDA IPC, reference control.py:19-46);
+    ring-slot credits provide equivalent backpressure semantics
+  * fixed max-shape batches + explicit valid-row counts everywhere, so
+    XLA compiles each stage exactly once (the reference sliced tensors to
+    the valid batch size, reference runner.py:109-114)
+"""
+
+__version__ = "0.1.0"
+
+from rnb_tpu.telemetry import TimeCard, TimeCardList, TimeCardSummary
+from rnb_tpu.stage import PaddedBatch, StageModel
+from rnb_tpu.selector import QueueSelector, RoundRobinSelector
+from rnb_tpu.video_path_provider import VideoPathIterator

@@ -1,0 +1,24 @@
+"""argparse value validators for the benchmark CLI.
+
+Reference parity: arg_utils.py:2-16.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def positive_int(value) -> int:
+    ivalue = int(value)
+    if ivalue <= 0:
+        raise argparse.ArgumentTypeError(
+            "%s is not a positive integer" % value)
+    return ivalue
+
+
+def nonnegative_int(value) -> int:
+    ivalue = int(value)
+    if ivalue < 0:
+        raise argparse.ArgumentTypeError(
+            "%s is not a non-negative integer" % value)
+    return ivalue

@@ -1,0 +1,73 @@
+"""Dynamic batching stage.
+
+Accumulates ``batch`` incoming requests and fuses them into one larger
+batch so a downstream network stage amortizes its launch/compile cost —
+the "Batch" half of Replicate & Batch. While accumulating, the stage
+returns a None time_card, which tells the executor to propagate nothing
+downstream (reference batcher.py:17-34, runner.py:130-134).
+
+The fused output is one PaddedBatch holding the concatenated *valid*
+rows of the constituents, re-padded to the stage's max shape, plus a
+TimeCardList so one fused inference still stamps every constituent
+request's card.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rnb_tpu.stage import PaddedBatch, StageModel
+from rnb_tpu.telemetry import TimeCardList
+
+MAX_ROWS = 15  # max clips per fused batch, matches the loader's max
+
+
+class Batcher(StageModel):
+    """Accumulate `batch` requests, then emit one fused PaddedBatch."""
+
+    def __init__(self, device, batch=1, **kwargs):
+        super().__init__(device)
+        self.batch = int(batch)
+        self._tensors = []      # list of tuples of PaddedBatch
+        self._time_cards = []
+
+    def input_shape(self):
+        return ((MAX_ROWS, 3, 8, 112, 112),)
+
+    @staticmethod
+    def output_shape():
+        return ((MAX_ROWS, 3, 8, 112, 112),)
+
+    def __call__(self, tensors, non_tensors, time_card):
+        if self.batch <= 1:
+            return tensors, non_tensors, time_card
+
+        # Validate before mutating state so an oversized request leaves the
+        # accumulator intact and the stage recoverable.
+        for pos, pb in enumerate(tensors):
+            pending = sum(parts[pos].valid for parts in self._tensors)
+            if pending + pb.valid > pb.max_rows:
+                raise ValueError(
+                    "fusing this request would reach %d rows, exceeding the "
+                    "max shape %d; lower the `batch` config or raise the "
+                    "stage max shape"
+                    % (pending + pb.valid, pb.max_rows))
+
+        self._tensors.append(tensors)
+        self._time_cards.append(time_card)
+        if len(self._time_cards) < self.batch:
+            return None, None, None
+
+        fused = []
+        for parts in zip(*self._tensors):
+            rows = np.concatenate(
+                [np.asarray(pb.data)[: pb.valid] for pb in parts], axis=0)
+            fused.append(PaddedBatch.from_rows(rows, parts[0].max_rows))
+
+        cards = TimeCardList(self._time_cards)
+        self._tensors = []
+        self._time_cards = []
+        # Per-request metadata cannot be attributed to a fused batch; emit
+        # None rather than one arbitrary constituent's non_tensors
+        # (reference batcher.py:34 does the same).
+        return tuple(fused), None, cards

@@ -1,0 +1,35 @@
+"""Pluggable routing policies: which downstream queue receives an output.
+
+A stage group with several ``out_queues`` consults its
+:class:`QueueSelector` per request; selectors may inspect the tensors,
+the non-tensor payload, or the TimeCard (content-aware routing — the
+"Replicate & Batch" placement idea routes rare large videos to a
+dedicated lane, see models/r2p1d/model.py in this repo).
+
+Reference parity: selector.py:1-18.
+"""
+
+from __future__ import annotations
+
+
+class QueueSelector:
+    """Base contract: pick an output-queue index in [0, num_queues)."""
+
+    def __init__(self, num_queues: int):
+        self.num_queues = num_queues
+
+    def select(self, tensors, non_tensors, time_card) -> int:
+        raise NotImplementedError
+
+
+class RoundRobinSelector(QueueSelector):
+    """Cycle through the output queues regardless of content."""
+
+    def __init__(self, num_queues: int):
+        super().__init__(num_queues)
+        self._next = 0
+
+    def select(self, tensors, non_tensors, time_card) -> int:
+        choice = self._next
+        self._next = (self._next + 1) % self.num_queues
+        return choice

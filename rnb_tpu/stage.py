@@ -1,0 +1,97 @@
+"""The pipeline-stage plugin contract.
+
+A *stage model* is one step of the inference pipeline — decode, a
+(possibly partial) neural network, a batcher, an aggregator. Stage
+classes are named by string in JSON configs and loaded dynamically;
+the executor instantiates one per (step, group, device instance).
+
+Capability parity with the reference's RunnerModel (runner_model.py:1-81)
+with one deliberate TPU-first change: tensors move through the pipeline
+as fixed max-shape arrays with an explicit valid-row count
+(:class:`PaddedBatch`), never as dynamically-sized slices. XLA compiles
+a jitted stage exactly once per static shape; the reference instead
+sliced shared CUDA tensors to the valid batch size before each call
+(reference runner.py:109-114), which on TPU would trigger a
+recompilation per distinct clip count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PaddedBatch:
+    """A fixed max-shape array plus the number of leading valid rows.
+
+    ``data`` always has the stage's declared output shape (row 0 is the
+    batch/clip axis); rows ``valid:`` are padding and must be ignored by
+    consumers. This is the TPU-idiomatic encoding of the reference's
+    max-shape shared tensors + ``valid_batch_sizes`` side array
+    (reference control.py:34-39).
+    """
+
+    data: Any          # numpy or jax.Array, shape = (max_rows, ...)
+    valid: int         # number of meaningful leading rows
+
+    @property
+    def max_rows(self) -> int:
+        return int(self.data.shape[0])
+
+    def valid_data(self):
+        """Host-side view of the meaningful rows (do not use inside jit)."""
+        return self.data[: self.valid]
+
+    @staticmethod
+    def from_rows(rows, max_rows: int, dtype=None) -> "PaddedBatch":
+        """Pad a (n, ...) host array up to (max_rows, ...) with zeros."""
+        rows = np.asarray(rows, dtype=dtype)
+        n = rows.shape[0]
+        if n > max_rows:
+            raise ValueError("batch of %d rows exceeds max_rows=%d"
+                             % (n, max_rows))
+        if n == max_rows:
+            return PaddedBatch(rows, n)
+        pad = np.zeros((max_rows - n,) + rows.shape[1:], dtype=rows.dtype)
+        return PaddedBatch(np.concatenate([rows, pad], axis=0), n)
+
+
+class StageModel:
+    """Abstract contract every pipeline stage implements.
+
+    Lifecycle (all in the executor thread that owns the stage's devices):
+
+    * ``__init__(device, **kwargs)`` — build the stage, load weights, and
+      *warm up* (jit-compile with dummy inputs) so steady-state requests
+      never pay compilation latency. Extra JSON config keys arrive as
+      kwargs (reference runner_model.py:3-14, benchmark.py:241-246).
+    * ``input_shape()`` — nested tuple of expected per-tensor shapes, or
+      None if the stage takes no tensor inputs (reference
+      runner_model.py:16-29).
+    * ``output_shape()`` — static; tuple of max shapes of the produced
+      tensors, or None meaning "this stage emits no tensors", in which
+      case the runtime allocates no device ring for it (reference
+      runner_model.py:31-46 — note None differs from ``()``).
+    * ``__call__(tensors, non_tensors, time_card)`` — run one request.
+      ``tensors`` is a tuple of :class:`PaddedBatch` (or None for the
+      first stage); returns ``(tensors, non_tensors, time_card)`` where a
+      None time_card means the stage swallowed the item (e.g. a batcher
+      still accumulating) and nothing propagates downstream (reference
+      runner_model.py:48-81, runner.py:130-134).
+    """
+
+    def __init__(self, device, **kwargs):
+        self.device = device
+
+    def input_shape(self) -> Optional[Sequence]:
+        return None
+
+    @staticmethod
+    def output_shape() -> Optional[Tuple[Tuple[int, ...], ...]]:
+        return None
+
+    def __call__(self, tensors, non_tensors, time_card):
+        raise NotImplementedError

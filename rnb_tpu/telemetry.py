@@ -1,0 +1,259 @@
+"""Request-scoped software tracing: per-request event timelines.
+
+Every request travelling through the pipeline carries a TimeCard; each
+stage stamps named events on it (``runner{i}_start``, ``inference{i}_start``,
+``inference{i}_finish``) together with a trail of the devices it visited.
+Segment-parallel execution forks a card per segment and the aggregation
+stage merges the siblings back into one card whose post-fork events carry
+``-{sub_id}`` suffixes.
+
+Capability parity with the reference's rnb_logging.py (TimeCard
+rnb_logging.py:22-123, TimeCardList :126-142, TimeCardSummary :145-214,
+log path helpers :6-19), re-designed for the TPU runtime: device trails
+are arbitrary string labels ("tpu:3", "cpu:0", "host") instead of GPU
+integers, and log filenames use a device-label scheme.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from typing import IO, Iterable, List, Optional, Sequence
+
+
+def logroot(job_id: str, base: str = "logs") -> str:
+    """Directory holding every artifact of one benchmark job."""
+    path = os.path.join(base, str(job_id))
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def logmeta(job_id: str, base: str = "logs") -> str:
+    """Path of the job metadata file (args, wall time, termination code)."""
+    return os.path.join(logroot(job_id, base), "log-meta.txt")
+
+
+def logname(job_id: str, device_label: str, group_idx: int, instance_idx: int,
+            base: str = "logs") -> str:
+    """Path of the per-final-instance timing table.
+
+    Mirrors the reference's ``g{gpu}-group{group}-{instance}.txt`` scheme
+    (rnb_logging.py:17-19) with a device label usable for TPU cores.
+    """
+    safe = str(device_label).replace(":", "").replace("/", "-")
+    return os.path.join(
+        logroot(job_id, base),
+        "%s-group%d-%d.txt" % (safe, group_idx, instance_idx))
+
+
+class TimeCard:
+    """An ordered event->timestamp record that rides along with a request.
+
+    Reference behavior: rnb_logging.py:22-123. Supports single-level
+    fork (one child per parallel segment) and merge (recombine siblings:
+    pre-fork events kept once, post-fork events suffixed ``-{sub_id}``,
+    device trails merged positionally).
+    """
+
+    def __init__(self, id: int):
+        self.timings: "OrderedDict[str, float]" = OrderedDict()
+        self.id = id
+        self.sub_id: Optional[int] = None
+        self.num_parent_timings: Optional[int] = None
+        # One entry per pipeline step traversed; each entry is a tuple of
+        # device labels (singleton until a merge combines segments that ran
+        # on different devices).
+        self.devices: List[tuple] = []
+
+    def record(self, key: str) -> None:
+        """Stamp event ``key`` with the current wall-clock time."""
+        self.timings[key] = time.time()
+
+    def add_device(self, device_label: str) -> None:
+        """Append a pipeline-step device visit to the trail."""
+        self.devices.append((device_label,))
+
+    def fork(self, sub_id: int) -> "TimeCard":
+        """Clone this card for one parallel segment.
+
+        The clone keeps the same id and a copy of all timings; the fork
+        point is remembered so merge() knows which events are shared.
+        Two-level forking is rejected — merge before forking again
+        (reference invariant, rnb_logging.py:56-62).
+        """
+        if self.sub_id is not None:
+            raise RuntimeError(
+                "cannot fork TimeCard(id=%s) twice: it is already a fork "
+                "with sub_id=%s; merge first" % (self.id, self.sub_id))
+        child = TimeCard(self.id)
+        child.timings = OrderedDict(self.timings)
+        child.sub_id = sub_id
+        child.num_parent_timings = len(self.timings)
+        child.devices = list(self.devices)
+        return child
+
+    @staticmethod
+    def merge(time_cards: Sequence["TimeCard"]) -> "TimeCard":
+        """Recombine sibling forks into one card.
+
+        All inputs must share id-independent structure: identical timing
+        keys and identical fork points. Events recorded before the fork
+        are emitted once; events after the fork are emitted per sibling
+        with a ``-{sub_id}`` suffix, ordered by sub_id. Device trails are
+        zipped positionally: a step where every sibling used the same
+        device collapses to a singleton, otherwise the full tuple is kept
+        (reference behavior, rnb_logging.py:72-123).
+        """
+        if not time_cards:
+            raise ValueError("merge() needs at least one TimeCard")
+        first = time_cards[0]
+        keys = list(first.timings.keys())
+        fork_point = first.num_parent_timings
+        seen_sub_ids = set()
+        for tc in time_cards:
+            if tc.sub_id is None:
+                raise RuntimeError(
+                    "cannot merge TimeCard(id=%s): not a fork (sub_id is "
+                    "None); only sibling forks can be merged" % tc.id)
+            if tc.sub_id in seen_sub_ids:
+                raise RuntimeError(
+                    "cannot merge TimeCards with duplicate sub_id=%s"
+                    % tc.sub_id)
+            seen_sub_ids.add(tc.sub_id)
+        for tc in time_cards[1:]:
+            if list(tc.timings.keys()) != keys:
+                raise RuntimeError(
+                    "cannot merge TimeCards with different timing keys: "
+                    "%s != %s" % (keys, list(tc.timings.keys())))
+            if tc.num_parent_timings != fork_point:
+                raise RuntimeError(
+                    "cannot merge TimeCards forked at different points: "
+                    "%s != %s" % (fork_point, tc.num_parent_timings))
+        ordered = sorted(time_cards, key=lambda tc: tc.sub_id)
+
+        merged = TimeCard(first.id)
+        for key_idx, key in enumerate(keys):
+            if fork_point is not None and key_idx < fork_point:
+                merged.timings[key] = ordered[0].timings[key]
+            else:
+                for tc in ordered:
+                    merged.timings["%s-%s" % (key, tc.sub_id)] = tc.timings[key]
+
+        for step_devices in zip(*[tc.devices for tc in ordered]):
+            flat = tuple(d for tpl in step_devices for d in tpl)
+            if len(set(flat)) == 1:
+                merged.devices.append((flat[0],))
+            else:
+                merged.devices.append(flat)
+        return merged
+
+
+class TimeCardList:
+    """Broadcast wrapper over the cards of a dynamically-batched request.
+
+    Produced by the Batcher stage so that one fused inference still stamps
+    events on every constituent request's card (reference
+    rnb_logging.py:126-142). Forking a batched card is not meaningful.
+    """
+
+    def __init__(self, time_cards: List[TimeCard]):
+        self.time_cards = time_cards
+
+    def record(self, key: str) -> None:
+        for tc in self.time_cards:
+            tc.record(key)
+
+    def add_device(self, device_label: str) -> None:
+        for tc in self.time_cards:
+            tc.add_device(device_label)
+
+    def fork(self, sub_id: int) -> "TimeCard":
+        raise NotImplementedError("TimeCardLists cannot be forked")
+
+    def __len__(self) -> int:
+        return len(self.time_cards)
+
+
+class TimeCardSummary:
+    """Columnar accumulator over completed requests' TimeCards.
+
+    Assumes every registered card carries the identical event-key sequence
+    (true per final-step instance because the pipeline topology is fixed);
+    prints mean inter-event gaps and persists a whitespace table with one
+    row per request plus per-step device columns (split per segment when a
+    step ran on several devices). Reference: rnb_logging.py:145-214.
+    """
+
+    def __init__(self):
+        self.summary: "OrderedDict[str, List[float]]" = OrderedDict()
+        self.keys: List[str] = []
+        self.devices_per_inference: List[List[tuple]] = []
+
+    def register(self, time_card: TimeCard) -> None:
+        if not self.summary:
+            self.keys = list(time_card.timings.keys())
+            for key in self.keys:
+                self.summary[key] = []
+        if self.keys != list(time_card.timings.keys()):
+            raise AssertionError(
+                "TimeCard key sequence changed mid-run: %s != %s"
+                % (self.keys, list(time_card.timings.keys())))
+        for key, ts in time_card.timings.items():
+            self.summary[key].append(ts)
+        self.devices_per_inference.append(time_card.devices)
+
+    def num_records(self) -> int:
+        return len(self.summary[self.keys[0]]) if self.keys else 0
+
+    def mean_gaps_ms(self, num_skips: int = 0):
+        """[(prev_key, next_key, mean_ms)] over records after `num_skips`."""
+        import numpy as np
+        out = []
+        for prv, nxt in zip(self.keys[:-1], self.keys[1:]):
+            if len(self.summary[prv]) <= num_skips:
+                return out
+            gap = np.mean(
+                (np.asarray(self.summary[nxt][num_skips:])
+                 - np.asarray(self.summary[prv][num_skips:])) * 1000.0)
+            out.append((prv, nxt, float(gap)))
+        return out
+
+    def print_summary(self, num_skips: int) -> None:
+        gaps = self.mean_gaps_ms(num_skips)
+        if not gaps and self.keys:
+            print("Not enough log entries (%d records) to print summary!"
+                  % self.num_records())
+        for prv, nxt, ms in gaps:
+            print("Average time between %s and %s: %f ms" % (prv, nxt, ms))
+
+    def save_full_report(self, fp: IO[str]) -> None:
+        # Per-step device-column widths can differ across records (a merge
+        # collapses segments that happened to share a device); size each
+        # step's columns to the widest record and pad narrower rows with
+        # '-' so the whitespace table stays rectangular.
+        num_steps = max((len(d) for d in self.devices_per_inference),
+                        default=0)
+        widths = [0] * num_steps
+        for devices_per_step in self.devices_per_inference:
+            for step_idx, step_devices in enumerate(devices_per_step):
+                widths[step_idx] = max(widths[step_idx], len(step_devices))
+
+        fp.write(" ".join(self.keys))
+        for step_idx, width in enumerate(widths):
+            if width > 1:
+                for sub_id in range(width):
+                    fp.write(" device%d-%d" % (step_idx, sub_id))
+            else:
+                fp.write(" device%d" % step_idx)
+        fp.write("\n")
+        for row, devices_per_step in zip(zip(*self.summary.values()),
+                                         self.devices_per_inference):
+            fp.write(" ".join(map(str, row)))
+            for step_idx, width in enumerate(widths):
+                step_devices = (devices_per_step[step_idx]
+                                if step_idx < len(devices_per_step) else ())
+                for col in range(width):
+                    fp.write(" %s" % (step_devices[col]
+                                      if col < len(step_devices) else "-"))
+            fp.write("\n")

@@ -1,0 +1,23 @@
+"""Dynamic class loading — the plugin mechanism behind string-named
+models, selectors and path iterators in JSON configs.
+
+Reference parity: utils/class_utils.py:1-8.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+
+def load_class(full_class_path: str):
+    """Load a class from a dotted path like ``pkg.module.ClassName``."""
+    module_path, _, class_name = full_class_path.rpartition(".")
+    if not module_path:
+        raise ValueError("expected a dotted class path, got %r"
+                         % full_class_path)
+    module = importlib.import_module(module_path)
+    try:
+        return getattr(module, class_name)
+    except AttributeError as e:
+        raise ImportError("module %r has no class %r"
+                          % (module_path, class_name)) from e

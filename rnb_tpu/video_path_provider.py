@@ -1,0 +1,21 @@
+"""Request-stream sources: iterables of video paths for the client.
+
+A concrete iterator is named by string in the JSON config
+(``video_path_iterator``) and instantiated inside the client thread.
+Implementations should cycle indefinitely (e.g. ``itertools.cycle``) so
+any requested video count can be served regardless of dataset size.
+
+Reference parity: video_path_provider.py:1-14.
+"""
+
+from __future__ import annotations
+
+
+class VideoPathIterator:
+    """Base contract: iterate video paths (or synthetic video ids) forever."""
+
+    def __init__(self):
+        pass
+
+    def __iter__(self):
+        raise NotImplementedError

@@ -1,0 +1,19 @@
+"""Test harness: force an 8-virtual-device CPU JAX backend.
+
+Multi-core placement, sharding and mesh logic all run on a simulated
+8-device CPU platform so the suite never needs TPU hardware — the
+idiomatic JAX substitute for a fake backend (SURVEY.md §4). Must run
+before anything imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

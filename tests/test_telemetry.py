@@ -1,0 +1,207 @@
+"""TimeCard fork/merge invariants and summary output.
+
+Covers the invariants catalogued from the reference (SURVEY.md §4):
+fork/merge correctness (rnb_logging.py:42-123), key-sequence consistency
+in the summary (rnb_logging.py:163), and the full-report table layout.
+"""
+
+import io
+
+import pytest
+
+from rnb_tpu.telemetry import (TimeCard, TimeCardList, TimeCardSummary,
+                               logmeta, logname, logroot)
+
+
+def test_record_preserves_order():
+    tc = TimeCard(0)
+    tc.record("a")
+    tc.record("b")
+    tc.record("c")
+    assert list(tc.timings.keys()) == ["a", "b", "c"]
+    assert tc.timings["a"] <= tc.timings["b"] <= tc.timings["c"]
+
+
+def test_fork_is_deep_and_tracks_fork_point():
+    tc = TimeCard(7)
+    tc.record("a")
+    tc.add_device("tpu:0")
+    child = tc.fork(2)
+    child.record("b")
+    child.add_device("tpu:1")
+    assert child.id == 7
+    assert child.sub_id == 2
+    assert child.num_parent_timings == 1
+    assert "b" not in tc.timings
+    assert tc.devices == [("tpu:0",)]
+    assert child.devices == [("tpu:0",), ("tpu:1",)]
+
+
+def test_two_level_fork_rejected():
+    tc = TimeCard(0)
+    child = tc.fork(0)
+    with pytest.raises(RuntimeError):
+        child.fork(1)
+
+
+def test_merge_suffixes_post_fork_keys_and_merges_devices():
+    parent = TimeCard(3)
+    parent.record("enqueue")
+    parent.add_device("tpu:0")
+    children = []
+    for seg in (1, 0):  # deliberately out of order; merge sorts by sub_id
+        c = parent.fork(seg)
+        c.add_device("tpu:%d" % (seg + 1))
+        c.record("net_start")
+        c.record("net_finish")
+        children.append(c)
+    merged = TimeCard.merge(children)
+    assert list(merged.timings.keys()) == [
+        "enqueue",
+        "net_start-0", "net_start-1",
+        "net_finish-0", "net_finish-1",
+    ]
+    # shared pre-fork step collapses, divergent step keeps the tuple
+    assert merged.devices == [("tpu:0",), ("tpu:1", "tpu:2")]
+
+
+def test_merge_same_device_collapses():
+    parent = TimeCard(1)
+    parent.record("x")
+    kids = [parent.fork(i) for i in range(3)]
+    for k in kids:
+        k.add_device("tpu:5")
+        k.record("y")
+    merged = TimeCard.merge(kids)
+    assert merged.devices == [("tpu:5",)]
+
+
+def test_merge_rejects_mismatched_keys():
+    parent = TimeCard(0)
+    parent.record("a")
+    c0, c1 = parent.fork(0), parent.fork(1)
+    c0.record("b")
+    c1.record("OTHER")
+    with pytest.raises(RuntimeError):
+        TimeCard.merge([c0, c1])
+
+
+def test_merge_rejects_mismatched_fork_points():
+    p = TimeCard(0)
+    c0 = p.fork(0)
+    p.record("a")
+    c1 = p.fork(1)
+    c0.record("a")
+    with pytest.raises(RuntimeError):
+        TimeCard.merge([c0, c1])
+
+
+def test_timecardlist_broadcasts():
+    cards = [TimeCard(i) for i in range(3)]
+    lst = TimeCardList(cards)
+    lst.record("evt")
+    lst.add_device("cpu:0")
+    for tc in cards:
+        assert "evt" in tc.timings
+        assert tc.devices == [("cpu:0",)]
+    with pytest.raises(NotImplementedError):
+        lst.fork(0)
+
+
+def test_summary_asserts_key_consistency():
+    s = TimeCardSummary()
+    a = TimeCard(0)
+    a.record("x")
+    s.register(a)
+    b = TimeCard(1)
+    b.record("DIFFERENT")
+    with pytest.raises(AssertionError):
+        s.register(b)
+
+
+def test_summary_mean_gaps_and_report():
+    s = TimeCardSummary()
+    for i in range(4):
+        tc = TimeCard(i)
+        tc.record("start")
+        tc.timings["finish"] = tc.timings["start"] + 0.010  # exactly 10ms
+        tc.add_device("tpu:0")
+        s.register(tc)
+    gaps = s.mean_gaps_ms(num_skips=1)
+    assert len(gaps) == 1
+    prv, nxt, ms = gaps[0]
+    assert (prv, nxt) == ("start", "finish")
+    assert ms == pytest.approx(10.0, abs=0.1)
+
+    buf = io.StringIO()
+    s.save_full_report(buf)
+    lines = buf.getvalue().strip().split("\n")
+    assert lines[0].split() == ["start", "finish", "device0"]
+    assert len(lines) == 1 + 4
+    assert lines[1].split()[-1] == "tpu:0"
+
+
+def test_summary_report_splits_segmented_device_columns():
+    s = TimeCardSummary()
+    parent = TimeCard(0)
+    parent.record("a")
+    kids = [parent.fork(i) for i in range(2)]
+    for i, k in enumerate(kids):
+        k.add_device("tpu:%d" % i)
+        k.record("b")
+    s.register(TimeCard.merge(kids))
+    buf = io.StringIO()
+    s.save_full_report(buf)
+    header = buf.getvalue().split("\n")[0].split()
+    assert header == ["a", "b-0", "b-1", "device0-0", "device0-1"]
+
+
+def test_summary_report_pads_variable_device_widths():
+    # record 0: both segments on the same device (collapses to width 1);
+    # record 1: segments diverge (width 2). Table must stay rectangular.
+    s = TimeCardSummary()
+    for rec, devs in enumerate([("tpu:0", "tpu:0"), ("tpu:1", "tpu:2")]):
+        parent = TimeCard(rec)
+        parent.record("a")
+        kids = [parent.fork(i) for i in range(2)]
+        for k, d in zip(kids, devs):
+            k.add_device(d)
+            k.record("b")
+        s.register(TimeCard.merge(kids))
+    buf = io.StringIO()
+    s.save_full_report(buf)
+    lines = buf.getvalue().strip().split("\n")
+    header = lines[0].split()
+    assert header == ["a", "b-0", "b-1", "device0-0", "device0-1"]
+    assert all(len(line.split()) == len(header) for line in lines[1:])
+    assert lines[1].split()[-2:] == ["tpu:0", "-"]
+    assert lines[2].split()[-2:] == ["tpu:1", "tpu:2"]
+
+
+def test_merge_rejects_unforked_and_duplicate_sub_ids():
+    a, b = TimeCard(1), TimeCard(1)
+    a.record("x")
+    b.record("x")
+    with pytest.raises(RuntimeError):
+        TimeCard.merge([a, b])
+    parent = TimeCard(2)
+    with pytest.raises(RuntimeError):
+        TimeCard.merge([parent.fork(0), parent.fork(0)])
+
+
+def test_mean_gaps_not_enough_records():
+    s = TimeCardSummary()
+    tc = TimeCard(0)
+    tc.record("a")
+    tc.record("b")
+    s.register(tc)
+    assert s.mean_gaps_ms(num_skips=5) == []
+
+
+def test_log_paths(tmp_path):
+    base = str(tmp_path)
+    root = logroot("job1", base=base)
+    assert root.endswith("job1")
+    assert logmeta("job1", base=base).endswith("log-meta.txt")
+    name = logname("job1", "tpu:3", 2, 1, base=base)
+    assert name.endswith("tpu3-group2-1.txt")
