@@ -1,0 +1,218 @@
+"""Pipeline configuration: JSON schema, parsing and validation.
+
+A pipeline config names a video-path iterator plus an ordered list of
+*steps*; each step names a stage-model class and a list of *queue
+groups* placing replicas on devices and wiring them to numbered
+inter-stage queues. Any step/group key outside the reserved schema is
+forwarded verbatim to the stage constructor — the open kwargs
+passthrough that makes every model parameter configurable from JSON.
+
+Schema and validation parity with the reference (benchmark.py:23-125):
+same step/group structure, same queue-wiring rule (the out-queue set of
+step i must equal the in-queue set of step i+1), same last-step
+constraints (no multi-segment, no shared output tensors), same reserved
+keyword handling. TPU-first changes: the placement key is ``devices``
+(``gpus`` accepted as an alias for drop-in use of reference configs),
+-1 places a group on the host, and the availability probe inspects
+`jax.devices()` instead of NVML.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+from rnb_tpu.devices import DeviceSpec
+
+RESERVED_KEYWORDS = [
+    "model", "queue_groups", "num_shared_tensors", "num_segments",
+    "in_queue", "out_queues", "devices", "gpus", "queue_selector",
+]
+
+DEFAULT_QUEUE_SELECTOR = "rnb_tpu.selector.RoundRobinSelector"
+
+
+class ConfigError(ValueError):
+    """Malformed pipeline configuration."""
+
+
+def _expect(cond: bool, message: str) -> None:
+    if not cond:
+        raise ConfigError(message)
+
+
+@dataclasses.dataclass
+class GroupConfig:
+    """One queue group: replicas on `devices` sharing one in-queue and a
+    selector-routed set of out-queues."""
+
+    devices: List[DeviceSpec]
+    in_queue: Optional[int]
+    out_queues: List[int]
+    queue_selector: str
+    extras: Dict[str, Any]
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.devices)
+
+
+@dataclasses.dataclass
+class StepConfig:
+    """One pipeline step: a stage-model class fanned out over groups."""
+
+    model: str
+    groups: List[GroupConfig]
+    num_segments: int
+    num_shared_tensors: Optional[int]
+    extras: Dict[str, Any]
+
+    def kwargs_for_group(self, group_idx: int) -> Dict[str, Any]:
+        """Model-constructor kwargs: step extras overridden by group extras
+        (reference benchmark.py:241-246)."""
+        merged = dict(self.extras)
+        merged.update(self.groups[group_idx].extras)
+        return merged
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    video_path_iterator: str
+    steps: List[StepConfig]
+    raw: Dict[str, Any]
+
+    @property
+    def num_steps(self) -> int:
+        return len(self.steps)
+
+    @property
+    def num_runners(self) -> int:
+        return sum(g.num_instances for s in self.steps for g in s.groups)
+
+    def all_devices(self) -> List[DeviceSpec]:
+        return [d for s in self.steps for g in s.groups for d in g.devices]
+
+    def check_devices(self) -> None:
+        """Resolve every placement against the visible JAX devices."""
+        from rnb_tpu.devices import check_devices
+        check_devices(self.all_devices())
+
+
+def load_config(path: str) -> PipelineConfig:
+    with open(path, "r") as f:
+        try:
+            raw = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ConfigError("config file %s is not valid JSON: %s"
+                              % (path, e)) from e
+    return parse_config(raw)
+
+
+def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
+    _expect(isinstance(raw, dict), "config root must be a JSON object")
+    _expect("video_path_iterator" in raw,
+            "config is missing 'video_path_iterator'")
+    _expect(isinstance(raw["video_path_iterator"], str),
+            "'video_path_iterator' must be a class-path string")
+    _expect("pipeline" in raw, "config is missing 'pipeline'")
+    pipeline = raw["pipeline"]
+    _expect(isinstance(pipeline, list) and pipeline,
+            "'pipeline' must be a non-empty list of steps")
+
+    steps: List[StepConfig] = []
+    prev_out_queues: Optional[set] = None
+    for step_idx, step_raw in enumerate(pipeline):
+        first = step_idx == 0
+        final = step_idx == len(pipeline) - 1
+        where = "pipeline step %d" % step_idx
+        _expect(isinstance(step_raw, dict), "%s must be an object" % where)
+        _expect(isinstance(step_raw.get("model"), str),
+                "%s needs a 'model' class-path string" % where)
+        groups_raw = step_raw.get("queue_groups")
+        _expect(isinstance(groups_raw, list) and groups_raw,
+                "%s needs a non-empty 'queue_groups' list" % where)
+
+        num_segments = step_raw.get("num_segments", 1)
+        _expect(isinstance(num_segments, int) and num_segments >= 1,
+                "%s: 'num_segments' must be a positive integer" % where)
+        _expect(not (final and num_segments != 1),
+                "the last step may not have multiple segments")
+
+        num_shared_tensors = step_raw.get("num_shared_tensors")
+        if num_shared_tensors is not None:
+            _expect(isinstance(num_shared_tensors, int)
+                    and num_shared_tensors >= 1,
+                    "%s: 'num_shared_tensors' must be a positive integer"
+                    % where)
+            _expect(not final,
+                    "the last step does not need shared output tensors")
+
+        groups: List[GroupConfig] = []
+        for group_idx, group_raw in enumerate(groups_raw):
+            gwhere = "%s, queue group %d" % (where, group_idx)
+            _expect(isinstance(group_raw, dict),
+                    "%s must be an object" % gwhere)
+            dev_key = ("devices" if "devices" in group_raw
+                       else "gpus" if "gpus" in group_raw else None)
+            _expect(dev_key is not None,
+                    "%s needs a 'devices' list" % gwhere)
+            devices_raw = group_raw[dev_key]
+            _expect(isinstance(devices_raw, list) and devices_raw,
+                    "%s: '%s' must be a non-empty list" % (gwhere, dev_key))
+            devices = [DeviceSpec(d) for d in devices_raw]
+
+            in_queue = group_raw.get("in_queue")
+            if first:
+                _expect(in_queue is None,
+                        "%s: the first step reads the filename queue and "
+                        "may not declare 'in_queue'" % gwhere)
+            else:
+                _expect(isinstance(in_queue, int),
+                        "%s needs an integer 'in_queue'" % gwhere)
+
+            out_queues = group_raw.get("out_queues", [])
+            if final:
+                _expect(not out_queues,
+                        "%s: the last step may not declare 'out_queues'"
+                        % gwhere)
+            else:
+                _expect(isinstance(out_queues, list) and out_queues
+                        and all(isinstance(q, int) for q in out_queues),
+                        "%s needs a non-empty integer 'out_queues' list"
+                        % gwhere)
+
+            selector = group_raw.get("queue_selector",
+                                     DEFAULT_QUEUE_SELECTOR)
+            _expect(isinstance(selector, str),
+                    "%s: 'queue_selector' must be a class-path string"
+                    % gwhere)
+
+            extras = {k: v for k, v in group_raw.items()
+                      if k not in RESERVED_KEYWORDS}
+            groups.append(GroupConfig(devices=devices, in_queue=in_queue,
+                                      out_queues=list(out_queues),
+                                      queue_selector=selector,
+                                      extras=extras))
+
+        # queue wiring: this step's in-queues must be exactly the previous
+        # step's out-queues (reference benchmark.py:79-87)
+        if not first:
+            in_queues = {g.in_queue for g in groups}
+            if in_queues != prev_out_queues:
+                raise ConfigError(
+                    "output queues of step %d %s do not match input queues "
+                    "of step %d %s"
+                    % (step_idx - 1, sorted(prev_out_queues),
+                       step_idx, sorted(in_queues)))
+        prev_out_queues = {q for g in groups for q in g.out_queues}
+
+        step_extras = {k: v for k, v in step_raw.items()
+                       if k not in RESERVED_KEYWORDS}
+        steps.append(StepConfig(model=step_raw["model"], groups=groups,
+                                num_segments=num_segments,
+                                num_shared_tensors=num_shared_tensors,
+                                extras=step_extras))
+
+    return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
+                          steps=steps, raw=raw)

@@ -1,0 +1,113 @@
+"""Device resolution and availability probing.
+
+Pipeline configs place stage groups on devices by logical index (or by
+explicit ``platform:index`` label). Index ``-1`` means "run on the host"
+— used for host-side stages like the aggregator (reference
+runner.py:31-44 ran those without CUDA). The availability probe replaces
+the reference's py3nvml memory-free check (reference benchmark.py:97-125)
+with `jax.devices()` introspection: on TPU the runtime owns every core in
+the slice, so existence is the meaningful check.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+DeviceSpecLike = Union[int, str]
+
+HOST_DEVICE_INDEX = -1
+
+
+class DeviceResolutionError(RuntimeError):
+    pass
+
+
+def accelerator_devices() -> list:
+    """Devices of the default JAX backend, in enumeration order.
+
+    Under a TPU runtime this is the TPU cores of the slice; in tests it
+    is the virtual CPU devices created by
+    ``--xla_force_host_platform_device_count``.
+    """
+    import jax
+    return list(jax.devices())
+
+
+def host_device():
+    """The first CPU device — where host-placed (-1) stages run."""
+    import jax
+    return jax.devices("cpu")[0]
+
+
+class DeviceSpec:
+    """A resolved placement: one JAX device plus a stable log label."""
+
+    def __init__(self, spec: DeviceSpecLike):
+        self.spec = spec
+        self._device = None  # resolved lazily so parsing needs no backend
+
+    @property
+    def is_host(self) -> bool:
+        return self.spec == HOST_DEVICE_INDEX
+
+    def resolve(self):
+        """Return the jax.Device this spec names (cached)."""
+        if self._device is not None:
+            return self._device
+        import jax
+        if isinstance(self.spec, int):
+            if self.spec == HOST_DEVICE_INDEX:
+                self._device = host_device()
+            else:
+                devices = accelerator_devices()
+                if not 0 <= self.spec < len(devices):
+                    raise DeviceResolutionError(
+                        "pipeline configuration names device %d but only %d "
+                        "devices are visible (%s)"
+                        % (self.spec, len(devices),
+                           [str(d) for d in devices]))
+                self._device = devices[self.spec]
+        elif isinstance(self.spec, str):
+            platform, _, idx = self.spec.partition(":")
+            try:
+                candidates = jax.devices(platform)
+            except RuntimeError as e:
+                raise DeviceResolutionError(
+                    "no %r backend available for device spec %r"
+                    % (platform, self.spec)) from e
+            index = int(idx) if idx else 0
+            if not 0 <= index < len(candidates):
+                raise DeviceResolutionError(
+                    "device spec %r out of range: %d %s devices visible"
+                    % (self.spec, len(candidates), platform))
+            self._device = candidates[index]
+        else:
+            raise DeviceResolutionError(
+                "unsupported device spec %r (want int or 'platform:idx')"
+                % (self.spec,))
+        return self._device
+
+    @property
+    def label(self) -> str:
+        """Stable string used in TimeCard device trails and log names."""
+        if self.is_host:
+            return "host"
+        if isinstance(self.spec, int):
+            d = self.resolve()
+            return "%s:%d" % (d.platform, d.id)
+        return str(self.spec)
+
+    def __repr__(self):
+        return "DeviceSpec(%r)" % (self.spec,)
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceSpec) and other.spec == self.spec
+
+    def __hash__(self):
+        return hash(self.spec)
+
+
+def check_devices(specs: List[DeviceSpec]) -> None:
+    """Resolve every spec, raising DeviceResolutionError for bad ones."""
+    for spec in specs:
+        spec.resolve()

@@ -2,18 +2,26 @@
 
 Multi-core placement, sharding and mesh logic all run on a simulated
 8-device CPU platform so the suite never needs TPU hardware — the
-idiomatic JAX substitute for a fake backend (SURVEY.md §4). Must run
-before anything imports jax.
+idiomatic JAX substitute for a fake backend (SURVEY.md §4).
+
+Note: setting the JAX_PLATFORMS env var is NOT sufficient in this
+environment — a site hook registers the TPU-tunnel PJRT plugin at
+interpreter startup and overrides the platform list via jax.config, so
+the config must be forced back to "cpu" before the first backend
+initialization or every jax.devices() call blocks on the TPU tunnel.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,131 @@
+"""Config parsing, schema validation, queue wiring, device resolution."""
+
+import glob
+import os
+
+import pytest
+
+from rnb_tpu.config import (ConfigError, PipelineConfig, load_config,
+                            parse_config)
+from rnb_tpu.devices import DeviceResolutionError, DeviceSpec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _minimal(overrides=None, step1=None, step0=None):
+    cfg = {
+        "video_path_iterator": "rnb_tpu.video_path_provider.VideoPathIterator",
+        "pipeline": [
+            {"model": "m.A",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             **(step0 or {})},
+            {"model": "m.B",
+             "queue_groups": [{"devices": [1], "in_queue": 0}],
+             **(step1 or {})},
+        ],
+    }
+    cfg.update(overrides or {})
+    return cfg
+
+
+def test_parse_minimal():
+    pc = parse_config(_minimal())
+    assert pc.num_steps == 2
+    assert pc.num_runners == 2
+    assert pc.steps[0].groups[0].out_queues == [0]
+    assert pc.steps[1].groups[0].in_queue == 0
+    assert pc.steps[0].num_segments == 1
+
+
+def test_gpus_alias_accepted():
+    raw = _minimal()
+    raw["pipeline"][0]["queue_groups"][0] = {"gpus": [0], "out_queues": [0]}
+    pc = parse_config(raw)
+    assert pc.steps[0].groups[0].devices == [DeviceSpec(0)]
+
+
+def test_kwargs_passthrough_step_and_group():
+    raw = _minimal(step1={"start_index": 1, "end_index": 5})
+    raw["pipeline"][1]["queue_groups"][0]["end_index"] = 3
+    pc = parse_config(raw)
+    kw = pc.steps[1].kwargs_for_group(0)
+    assert kw == {"start_index": 1, "end_index": 3}  # group overrides step
+
+
+def test_wiring_mismatch_rejected():
+    raw = _minimal()
+    raw["pipeline"][1]["queue_groups"][0]["in_queue"] = 5
+    with pytest.raises(ConfigError, match="do not match"):
+        parse_config(raw)
+
+
+def test_last_step_constraints():
+    with pytest.raises(ConfigError, match="last step may not have multiple"):
+        parse_config(_minimal(step1={"num_segments": 2}))
+    with pytest.raises(ConfigError, match="does not need shared output"):
+        parse_config(_minimal(step1={"num_shared_tensors": 4}))
+    raw = _minimal()
+    raw["pipeline"][1]["queue_groups"][0]["out_queues"] = [0]
+    with pytest.raises(ConfigError, match="may not declare 'out_queues'"):
+        parse_config(raw)
+
+
+def test_first_step_rejects_in_queue():
+    raw = _minimal()
+    raw["pipeline"][0]["queue_groups"][0]["in_queue"] = 0
+    with pytest.raises(ConfigError, match="filename queue"):
+        parse_config(raw)
+
+
+def test_missing_fields_rejected():
+    with pytest.raises(ConfigError, match="video_path_iterator"):
+        parse_config({"pipeline": []})
+    with pytest.raises(ConfigError, match="non-empty"):
+        parse_config({"video_path_iterator": "x.Y", "pipeline": []})
+    raw = _minimal()
+    del raw["pipeline"][0]["model"]
+    with pytest.raises(ConfigError, match="'model'"):
+        parse_config(raw)
+    raw = _minimal()
+    raw["pipeline"][0]["queue_groups"][0].pop("devices")
+    with pytest.raises(ConfigError, match="'devices'"):
+        parse_config(raw)
+
+
+def test_num_segments_validation():
+    with pytest.raises(ConfigError, match="positive integer"):
+        parse_config(_minimal(step0={"num_segments": 0}))
+    with pytest.raises(ConfigError, match="positive integer"):
+        parse_config(_minimal(step0={"num_segments": "3"}))
+
+
+def test_all_shipped_configs_parse_and_resolve():
+    for path in sorted(glob.glob(os.path.join(REPO_ROOT, "configs",
+                                              "*.json"))):
+        pc = load_config(path)
+        assert isinstance(pc, PipelineConfig)
+        # every shipped config must fit the 8-device test backend
+        pc.check_devices()
+
+
+def test_device_spec_resolution():
+    import jax
+    assert DeviceSpec(0).resolve() == jax.devices()[0]
+    assert DeviceSpec(-1).is_host
+    assert DeviceSpec(-1).resolve().platform == "cpu"
+    assert DeviceSpec("cpu:1").resolve() == jax.devices("cpu")[1]
+    assert DeviceSpec(-1).label == "host"
+    with pytest.raises(DeviceResolutionError, match="only"):
+        DeviceSpec(99).resolve()
+    with pytest.raises(DeviceResolutionError):
+        DeviceSpec("nope:0").resolve()
+    with pytest.raises(DeviceResolutionError):
+        DeviceSpec(2.5).resolve()
+
+
+def test_check_devices_over_config():
+    raw = _minimal()
+    raw["pipeline"][0]["queue_groups"][0]["devices"] = [42]
+    pc = parse_config(raw)
+    with pytest.raises(DeviceResolutionError):
+        pc.check_devices()
