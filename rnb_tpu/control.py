@@ -1,0 +1,293 @@
+"""The channel runtime: control queues, device buffer rings, termination.
+
+The pipeline's communication fabric, re-designed for a single-controller
+TPU runtime (capability parity with the reference's control.py:1-209):
+
+* **Control messages** travel through bounded ``queue.Queue`` channels as
+  ``(Signal|None, non_tensors, TimeCard)`` tuples — never bulk tensors.
+  Queue overflow is a *failure signal*, not backpressure: the run aborts
+  with a reason code (reference semantics, README/runner.py:230-234).
+* **Bulk data** lives in per-instance :class:`BufferRing` s — a bounded
+  pool of slots, each holding a tuple of immutable device arrays plus
+  their valid-row counts. A slot's ``free`` event provides the
+  producer/consumer ownership handoff the reference implemented with
+  ``mp.Event`` over shared CUDA tensors (control.py:19-46). Because JAX
+  arrays are immutable there is no data race to guard — the ring's job
+  here is *backpressure*: a producer blocks when all its slots hold
+  unconsumed outputs, bounding device memory exactly like the
+  reference's pre-allocated tensor pool.
+* **Coordination**: a :class:`TerminationState` any stage may raise
+  (first writer wins), inspected at every loop top; threading barriers
+  fence start/finish so init and teardown stay out of timing windows.
+
+Stage hand-off across devices happens when the *consumer* re-homes the
+arrays with ``jax.device_put`` onto its own device — on TPU hardware an
+ICI transfer, the analog of the reference's cross-GPU ``copy_``
+(runner.py:104-114).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import queue
+import threading
+from collections import namedtuple
+from typing import Dict, List, Optional, Tuple
+
+from rnb_tpu.config import PipelineConfig
+from rnb_tpu.devices import DeviceSpec
+from rnb_tpu.utils.class_utils import load_class
+
+#: default ring depth per producer instance (reference control.py:8)
+DEFAULT_NUM_SHARED_TENSORS = 10
+
+#: sentinel count marking end-of-stream on every edge (reference
+#: client.py:9, runner.py:3)
+NUM_EXIT_MARKERS = 10
+
+
+class TerminationFlag(enum.IntEnum):
+    """Job-wide termination reason codes (reference control.py:11-16)."""
+
+    UNSET = -1
+    TARGET_NUM_VIDEOS_REACHED = 0
+    FILENAME_QUEUE_FULL = 1
+    FRAME_QUEUE_FULL = 2
+
+
+class TerminationState:
+    """A raise-once job termination flag shared by every stage thread.
+
+    Any thread may raise it with a reason code; the first raise wins.
+    Replaces the reference's lock-free shared ``Value`` write
+    (runner.py:193) with an explicit first-writer-wins rule so the
+    recorded reason is deterministic.
+    """
+
+    def __init__(self):
+        self._value = TerminationFlag.UNSET
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> TerminationFlag:
+        return self._value
+
+    def raise_flag(self, code: TerminationFlag) -> None:
+        with self._lock:
+            if self._value == TerminationFlag.UNSET:
+                self._value = TerminationFlag(code)
+
+    @property
+    def terminated(self) -> bool:
+        return self._value != TerminationFlag.UNSET
+
+
+#: Pointer passed through control queues instead of tensor payloads:
+#: names the producer (group, instance) and the ring slot index
+#: (reference control.py:209).
+Signal = namedtuple("Signal", ("group_idx", "instance_idx", "tensor_idx"))
+
+
+def get_segmented_shapes(shapes: Tuple[Tuple[int, ...], ...],
+                         num_segments: int) -> Tuple[Tuple[int, ...], ...]:
+    """Shrink per-output max shapes to one segment's worth of rows.
+
+    A step with ``num_segments=k`` splits each output batch row-wise into
+    k segments, so downstream buffers only ever hold ``ceil(rows/k)``
+    rows (reference control.py:49-69).
+    """
+    if num_segments <= 1:
+        return shapes
+    out = []
+    for shape in shapes:
+        if not shape:
+            raise ValueError(
+                "cannot segment a scalar output shape %r" % (shape,))
+        out.append((math.ceil(shape[0] / num_segments),) + tuple(shape[1:]))
+    return tuple(out)
+
+
+class RingSlot:
+    """One credit of a BufferRing: free-event + the parked payload."""
+
+    __slots__ = ("free", "payload")
+
+    def __init__(self):
+        self.free = threading.Event()
+        self.free.set()  # set == free for reuse (reference control.py:23-33)
+        self.payload: Optional[tuple] = None
+
+    def write(self, payload: tuple) -> None:
+        """Park a payload (tuple of PaddedBatch) and mark occupied."""
+        self.payload = payload
+        self.free.clear()
+
+    def read(self) -> tuple:
+        return self.payload
+
+    def release(self) -> None:
+        """Consumer is done with the slot; producer may reuse it."""
+        self.payload = None
+        self.free.set()
+
+
+class BufferRing:
+    """A bounded slot pool owned by one producer instance.
+
+    The producer writes outputs round-robin into slots, blocking while
+    the next slot is still held by a consumer — the same backpressure
+    point as the reference's ``tensor_event.event.wait()``
+    (runner.py:161-163). ``wait_free`` polls the termination flag so a
+    dying pipeline can't deadlock a producer forever.
+    """
+
+    POLL_INTERVAL_S = 0.05
+
+    def __init__(self, num_slots: int, device: DeviceSpec,
+                 shapes: Tuple[Tuple[int, ...], ...]):
+        if num_slots < 1:
+            raise ValueError("BufferRing needs at least one slot")
+        self.slots = [RingSlot() for _ in range(num_slots)]
+        self.device = device
+        self.shapes = shapes
+
+    def __len__(self) -> int:
+        return len(self.slots)
+
+    def wait_free(self, slot_idx: int,
+                  termination: TerminationState) -> bool:
+        """Block until slot is free; False if the job died meanwhile."""
+        slot = self.slots[slot_idx]
+        while not slot.free.wait(timeout=self.POLL_INTERVAL_S):
+            if termination.terminated:
+                return False
+        return True
+
+    def release_all(self) -> None:
+        """Free every slot so blocked producers wake during teardown
+        (reference runner.py:247-253)."""
+        for slot in self.slots:
+            slot.release()
+
+
+class ChannelFabric:
+    """Builds and wires every queue and buffer ring of one pipeline.
+
+    Equivalent of the reference's ``SharedQueuesAndTensors``
+    (control.py:72-205): a filename queue feeding step 0, one bounded
+    queue per declared out-queue index per step, and a
+    [step][group][instance] ring pool for every non-final step whose
+    stage model declares tensor outputs (``output_shape() is not None``;
+    None means no ring is allocated — distinct from an empty tuple,
+    reference runner_model.py:31-46). Ring shapes come from the stage
+    class's static ``output_shape()`` shrunk by the step's
+    ``num_segments``.
+    """
+
+    def __init__(self, pipeline: PipelineConfig, queue_size: int):
+        self.pipeline = pipeline
+        self.queue_size = queue_size
+        self.filename_queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+
+        # queues[step_idx][queue_idx] -> Queue shared by that step's
+        # producers and the next step's consumers
+        self.queues: List[Dict[int, "queue.Queue"]] = []
+        # rings[step_idx][group_idx][instance_idx] -> BufferRing | None
+        self.rings: List[List[List[Optional[BufferRing]]]] = []
+
+        for step_idx, step in enumerate(pipeline.steps):
+            is_final = step_idx == pipeline.num_steps - 1
+
+            step_queues: Dict[int, "queue.Queue"] = {}
+            if not is_final:
+                for group in step.groups:
+                    for q_idx in group.out_queues:
+                        if q_idx not in step_queues:
+                            step_queues[q_idx] = queue.Queue(
+                                maxsize=queue_size)
+            self.queues.append(step_queues)
+
+            step_rings: List[List[Optional[BufferRing]]] = []
+            shapes = None
+            if not is_final:
+                model_class = load_class(step.model)
+                shapes = model_class.output_shape()
+                if shapes is not None:
+                    shapes = get_segmented_shapes(tuple(map(tuple, shapes)),
+                                                  step.num_segments)
+            num_slots = (step.num_shared_tensors
+                         if step.num_shared_tensors is not None
+                         else DEFAULT_NUM_SHARED_TENSORS)
+            for group in step.groups:
+                group_rings: List[Optional[BufferRing]] = []
+                for device in group.devices:
+                    if shapes is None:
+                        group_rings.append(None)
+                    else:
+                        group_rings.append(
+                            BufferRing(num_slots, device, shapes))
+                step_rings.append(group_rings)
+            self.rings.append(step_rings)
+
+    # -- accessors ---------------------------------------------------
+
+    def get_filename_queue(self) -> "queue.Queue":
+        return self.filename_queue
+
+    def get_queues(self, step_idx: int, group_idx: int):
+        """(in_queue, out_queues) for one group's runner instances.
+
+        Step 0 reads the filename queue; the final step has no out
+        queues (None). Reference: control.py:167-180.
+        """
+        group = self.pipeline.steps[step_idx].groups[group_idx]
+        if step_idx == 0:
+            in_queue = self.filename_queue
+        else:
+            in_queue = self.queues[step_idx - 1][group.in_queue]
+        if step_idx == self.pipeline.num_steps - 1:
+            out_queues = None
+        else:
+            out_queues = [self.queues[step_idx][q] for q in group.out_queues]
+        return in_queue, out_queues
+
+    def get_input_rings(self, step_idx: int,
+                        group_idx: int) -> Optional[Dict[int, List[Optional[BufferRing]]]]:
+        """Upstream rings a consumer may receive Signals into.
+
+        For a consumer group at ``step_idx``, returns
+        ``{upstream_group_idx: [ring per instance]}`` restricted to the
+        previous step's groups whose out-queues include this group's
+        in-queue; None for step 0 or when the upstream step allocates no
+        rings (reference control.py:182-205).
+        """
+        if step_idx == 0:
+            return None
+        group = self.pipeline.steps[step_idx].groups[group_idx]
+        upstream = self.pipeline.steps[step_idx - 1]
+        result: Dict[int, List[Optional[BufferRing]]] = {}
+        any_ring = False
+        for up_idx, up_group in enumerate(upstream.groups):
+            if group.in_queue in up_group.out_queues:
+                rings = self.rings[step_idx - 1][up_idx]
+                result[up_idx] = rings
+                if any(r is not None for r in rings):
+                    any_ring = True
+        return result if any_ring else None
+
+    def get_output_ring(self, step_idx: int, group_idx: int,
+                        instance_idx: int) -> Optional[BufferRing]:
+        return self.rings[step_idx][group_idx][instance_idx]
+
+    def all_rings(self) -> List[BufferRing]:
+        return [r for step in self.rings for group in step for r in group
+                if r is not None]
+
+    def send_exit_markers(self, target_queue: "queue.Queue") -> None:
+        """Mark end-of-stream; Full is benign during teardown."""
+        for _ in range(NUM_EXIT_MARKERS):
+            try:
+                target_queue.put_nowait(None)
+            except queue.Full:
+                return

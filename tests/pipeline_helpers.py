@@ -1,0 +1,76 @@
+"""Tiny stage models + path iterators used by runtime tests.
+
+These play the role of the reference's CPU fallback (`gpus: [-1]`) as a
+poor man's fake backend (SURVEY.md §4): minimal stages that exercise the
+pipeline machinery without heavyweight models.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rnb_tpu.stage import PaddedBatch, StageModel
+from rnb_tpu.video_path_provider import VideoPathIterator
+
+SHAPE = (4, 2)  # (max_rows, feature)
+
+
+class TinyLoader(StageModel):
+    """First stage: turns a request id string into a small batch."""
+
+    def __init__(self, device, rows_per_video=2, **kwargs):
+        super().__init__(device)
+        self.rows_per_video = int(rows_per_video)
+
+    @staticmethod
+    def output_shape():
+        return (SHAPE,)
+
+    def __call__(self, tensors, non_tensors, time_card):
+        vid = int(str(non_tensors).rsplit("-", 1)[-1])
+        rows = np.full((self.rows_per_video, SHAPE[1]), float(vid),
+                       dtype=np.float32)
+        return (PaddedBatch.from_rows(rows, SHAPE[0]),), vid, time_card
+
+
+class TinyDouble(StageModel):
+    """Middle stage: doubles the payload."""
+
+    def input_shape(self):
+        return (SHAPE,)
+
+    @staticmethod
+    def output_shape():
+        return (SHAPE,)
+
+    def __call__(self, tensors, non_tensors, time_card):
+        pb = tensors[0]
+        return (PaddedBatch(np.asarray(pb.data) * 2.0, pb.valid),), \
+            non_tensors, time_card
+
+
+class TinySink(StageModel):
+    """Final stage: no tensor outputs (output_shape None => no rings)."""
+
+    def __init__(self, device, **kwargs):
+        super().__init__(device)
+        self.seen = []
+
+    @staticmethod
+    def output_shape():
+        return None
+
+    def __call__(self, tensors, non_tensors, time_card):
+        if tensors is not None:
+            self.seen.append(np.asarray(tensors[0].data).copy())
+        return None, non_tensors, time_card
+
+
+class CountingPathIterator(VideoPathIterator):
+    """Yields synthetic request ids forever: video-0, video-1, ..."""
+
+    def __iter__(self):
+        i = 0
+        while True:
+            yield "video-%d" % i
+            i += 1

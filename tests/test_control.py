@@ -1,0 +1,183 @@
+"""Channel runtime: termination protocol, rings, fabric wiring."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from rnb_tpu.config import parse_config
+from rnb_tpu.control import (DEFAULT_NUM_SHARED_TENSORS, NUM_EXIT_MARKERS,
+                             BufferRing, ChannelFabric, Signal,
+                             TerminationFlag, TerminationState,
+                             get_segmented_shapes)
+from rnb_tpu.devices import DeviceSpec
+
+
+def test_termination_first_writer_wins():
+    t = TerminationState()
+    assert t.value == TerminationFlag.UNSET
+    assert not t.terminated
+    t.raise_flag(TerminationFlag.FRAME_QUEUE_FULL)
+    t.raise_flag(TerminationFlag.FILENAME_QUEUE_FULL)
+    assert t.value == TerminationFlag.FRAME_QUEUE_FULL
+    assert t.terminated
+
+
+def test_segmented_shapes():
+    shapes = ((15, 3, 8, 112, 112), (10, 400))
+    assert get_segmented_shapes(shapes, 1) == shapes
+    assert get_segmented_shapes(shapes, 3) == ((5, 3, 8, 112, 112), (4, 400))
+    assert get_segmented_shapes(((11, 4),), 3) == ((4, 4),)
+    with pytest.raises(ValueError):
+        get_segmented_shapes(((),), 2)
+
+
+def test_ring_slot_protocol():
+    ring = BufferRing(2, DeviceSpec(-1), ((4, 2),))
+    t = TerminationState()
+    slot = ring.slots[0]
+    assert slot.free.is_set()
+    slot.write(("payload",))
+    assert not slot.free.is_set()
+    assert slot.read() == ("payload",)
+    slot.release()
+    assert slot.free.is_set()
+    assert slot.payload is None
+    assert ring.wait_free(0, t)
+
+
+def test_ring_wait_free_blocks_until_release():
+    ring = BufferRing(1, DeviceSpec(-1), ((4, 2),))
+    t = TerminationState()
+    ring.slots[0].write(("x",))
+    result = {}
+
+    def producer():
+        result["ok"] = ring.wait_free(0, t)
+
+    th = threading.Thread(target=producer)
+    th.start()
+    time.sleep(0.12)
+    assert th.is_alive()  # still blocked on the occupied slot
+    ring.slots[0].release()
+    th.join(timeout=2)
+    assert result["ok"] is True
+
+
+def test_ring_wait_free_aborts_on_termination():
+    ring = BufferRing(1, DeviceSpec(-1), ((4, 2),))
+    t = TerminationState()
+    ring.slots[0].write(("x",))
+
+    def killer():
+        time.sleep(0.1)
+        t.raise_flag(TerminationFlag.FRAME_QUEUE_FULL)
+
+    threading.Thread(target=killer).start()
+    assert ring.wait_free(0, t) is False
+
+
+def test_ring_release_all():
+    ring = BufferRing(3, DeviceSpec(-1), ((4, 2),))
+    for s in ring.slots:
+        s.write(("y",))
+    ring.release_all()
+    assert all(s.free.is_set() for s in ring.slots)
+
+
+def _three_step_config():
+    return parse_config({
+        "video_path_iterator": "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [
+                 {"devices": [0, 1], "out_queues": [0]},
+                 {"devices": [2], "out_queues": [1]},
+             ],
+             "num_shared_tensors": 3},
+            {"model": "tests.pipeline_helpers.TinyDouble",
+             "queue_groups": [
+                 {"devices": [3], "in_queue": 0, "out_queues": [2]},
+                 {"devices": [4], "in_queue": 1, "out_queues": [2]},
+             ]},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [-1], "in_queue": 2}]},
+        ],
+    })
+
+
+def test_fabric_queue_wiring():
+    fabric = ChannelFabric(_three_step_config(), queue_size=8)
+    in_q, out_qs = fabric.get_queues(0, 0)
+    assert in_q is fabric.get_filename_queue()
+    assert len(out_qs) == 1
+    # group 1 of step 0 writes queue 1, read by group 1 of step 1
+    _, out_qs_g1 = fabric.get_queues(0, 1)
+    in_q_s1g1, _ = fabric.get_queues(1, 1)
+    assert out_qs_g1[0] is in_q_s1g1
+    # both step-1 groups write the same queue 2 object
+    _, a = fabric.get_queues(1, 0)
+    _, b = fabric.get_queues(1, 1)
+    assert a[0] is b[0]
+    # final step: no out queues
+    in_final, out_final = fabric.get_queues(2, 0)
+    assert out_final is None
+    assert in_final is a[0]
+
+
+def test_fabric_ring_allocation():
+    cfg = _three_step_config()
+    fabric = ChannelFabric(cfg, queue_size=8)
+    # step 0: configured 3 slots, one ring per instance
+    ring = fabric.get_output_ring(0, 0, 1)
+    assert len(ring) == 3
+    assert ring.shapes == ((4, 2),)
+    assert ring.device == DeviceSpec(1)
+    # step 1: default slot count
+    assert len(fabric.get_output_ring(1, 0, 0)) == DEFAULT_NUM_SHARED_TENSORS
+    # final step: no rings (and TinySink.output_shape() is None anyway)
+    assert fabric.get_output_ring(2, 0, 0) is None
+
+
+def test_fabric_input_rings_filtered_by_in_queue():
+    fabric = ChannelFabric(_three_step_config(), queue_size=8)
+    assert fabric.get_input_rings(0, 0) is None
+    # step1 group0 reads queue 0, written only by step0 group0 (2 instances)
+    rings = fabric.get_input_rings(1, 0)
+    assert set(rings.keys()) == {0}
+    assert len(rings[0]) == 2
+    # step1 group1 reads queue 1, written only by step0 group1
+    rings = fabric.get_input_rings(1, 1)
+    assert set(rings.keys()) == {1}
+    # the Signal names (group, instance, slot) and resolves to the ring
+    sig = Signal(group_idx=1, instance_idx=0, tensor_idx=2)
+    assert rings[sig.group_idx][sig.instance_idx] is \
+        fabric.get_output_ring(0, 1, 0)
+
+
+def test_fabric_segmented_ring_shapes():
+    raw = {
+        "video_path_iterator": "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_segments": 3},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [-1], "in_queue": 0}]},
+        ],
+    }
+    fabric = ChannelFabric(parse_config(raw), queue_size=4)
+    # (4, 2) rows split 3 ways -> ceil(4/3) = 2 rows per segment
+    assert fabric.get_output_ring(0, 0, 0).shapes == ((2, 2),)
+
+
+def test_exit_markers():
+    fabric = ChannelFabric(_three_step_config(), queue_size=100)
+    q = fabric.get_filename_queue()
+    fabric.send_exit_markers(q)
+    assert q.qsize() == NUM_EXIT_MARKERS
+    # Full during teardown is benign
+    small = queue.Queue(maxsize=2)
+    fabric.send_exit_markers(small)
+    assert small.qsize() == 2
