@@ -1,0 +1,122 @@
+"""Checkpoint save/load with per-layer-range filtering.
+
+The reference loaded a pretrained Kinetics-400 torch checkpoint from a
+hardcoded path and filtered the state dict so a partial-network stage
+only received its own layers' weights (reference
+models/r2p1d/model.py:18,50-63). This module provides the same
+capability on Flax variable trees (msgpack on disk): a full-model
+checkpoint is filtered down to exactly the modules a [start..end]
+range instantiates, so every stage of a partitioned pipeline shares one
+set of weights.
+
+No pretrained weights are available in this environment, so
+:func:`ensure_checkpoint` materializes a deterministic seeded
+initialization once and reuses it — every stage and every process
+loads identical weights, which is what the parity benchmarks need.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
+                                          LAYER_INPUT_SHAPES, NUM_LAYERS,
+                                          R2Plus1DClassifier)
+
+DEFAULT_CKPT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "checkpoints")
+DEFAULT_CKPT_PATH = os.path.join(DEFAULT_CKPT_DIR,
+                                 "r2p1d18_kinetics400.msgpack")
+
+_ensure_lock = threading.Lock()
+
+
+def init_variables(seed: int = 0, start: int = 1, end: int = NUM_LAYERS,
+                   num_classes: int = KINETICS_CLASSES,
+                   layer_sizes=None) -> Dict[str, Any]:
+    """Seeded init of the [start..end] classifier's variables
+    (params + batch_stats).
+
+    Conv/BN/Dense parameter shapes are independent of the spatial and
+    temporal extent, so init traces a tiny dummy under jit — orders of
+    magnitude cheaper than tracing the real 112x112x8 shape.
+    """
+    import jax
+    kwargs = {} if layer_sizes is None else {"layer_sizes": layer_sizes}
+    model = R2Plus1DClassifier(start=start, end=end,
+                               num_classes=num_classes, **kwargs)
+    channels = LAYER_INPUT_SHAPES[start][-1]
+    dummy = np.zeros((1, 2, 14, 14, channels), dtype=np.float32)
+    init = jax.jit(lambda key: model.init(key, dummy, train=False))
+    return jax.tree.map(np.asarray, init(jax.random.key(seed)))
+
+
+def save_checkpoint(path: str, variables: Dict[str, Any]) -> None:
+    from flax import serialization
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.msgpack_serialize(
+            serialization.to_state_dict(variables)))
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    from flax import serialization
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def ensure_checkpoint(path: Optional[str] = None, seed: int = 0) -> str:
+    """Create the shared full-model checkpoint if absent; return path."""
+    path = path or DEFAULT_CKPT_PATH
+    with _ensure_lock:
+        if not os.path.exists(path):
+            save_checkpoint(path, init_variables(seed=seed))
+    return path
+
+
+def _range_module_names(start: int, end: int) -> set:
+    names = set()
+    for layer in range(start, end + 1):
+        names.add("conv%d" % layer)
+        if layer == 1:
+            names.add("stem_bn")
+    return names
+
+
+def filter_layer_range(variables: Dict[str, Any], start: int,
+                       end: int) -> Dict[str, Any]:
+    """Restrict a full-model variable tree to a layer range.
+
+    Keeps ``net/conv{i}`` (plus the stem BN with layer 1) for i in
+    [start..end] and the ``linear`` head only when the range reaches the
+    final layer — the same per-range weight filtering the reference
+    applied to torch state dicts (models/r2p1d/model.py:52-63).
+    """
+    if not (1 <= start <= end <= NUM_LAYERS):
+        raise ValueError("invalid layer range [%s..%s]" % (start, end))
+    keep = _range_module_names(start, end)
+    out: Dict[str, Any] = {}
+    for collection, tree in variables.items():
+        new_tree: Dict[str, Any] = {}
+        net = tree.get("net", {})
+        kept_net = {name: sub for name, sub in net.items() if name in keep}
+        if kept_net:
+            new_tree["net"] = kept_net
+        if end == NUM_LAYERS and "linear" in tree:
+            new_tree["linear"] = tree["linear"]
+        out[collection] = new_tree
+    return out
+
+
+def load_for_range(start: int, end: int,
+                   path: Optional[str] = None) -> Dict[str, Any]:
+    """Load the shared checkpoint filtered to [start..end]."""
+    return filter_layer_range(load_checkpoint(ensure_checkpoint(path)),
+                              start, end)

@@ -1,0 +1,115 @@
+"""R(2+1)D Flax network: factorization math, shapes, partial ranges.
+
+Small spatial/temporal extents keep CPU compile time low — conv
+parameter shapes are extent-independent, so structure checks transfer
+to the full 112x112x8 geometry.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rnb_tpu.models.r2p1d.network import (LAYER_INPUT_SHAPES,
+                                          R2Plus1DClassifier, R2Plus1DNet,
+                                          SpatioTemporalConv,
+                                          factored_channels)
+
+DTYPE = jnp.float32  # CPU-friendly for tests; stages default to bf16
+
+
+def test_factored_channels_matches_parameter_budget():
+    # M_i chosen so the factored pair's parameter count approximates the
+    # full 3-D kernel's t*d*d*in*out from below
+    for in_c, out_c, t, d in [(3, 64, 3, 7), (64, 64, 3, 3),
+                              (128, 256, 3, 3)]:
+        m = factored_channels(in_c, out_c, t, d)
+        full = t * d * d * in_c * out_c
+        factored = d * d * in_c * m + t * m * out_c
+        assert factored <= full
+        # adding one more channel would overshoot
+        overshoot = d * d * in_c * (m + 1) + t * (m + 1) * out_c
+        assert overshoot > full
+
+
+def test_spatiotemporal_conv_is_factored():
+    conv = SpatioTemporalConv(features=16, kernel=(3, 3), dtype=DTYPE)
+    params = conv.init(jax.random.key(0),
+                       jnp.zeros((1, 4, 8, 8, 8)), train=False)["params"]
+    assert set(params.keys()) == {"spatial", "bn", "temporal"}
+    # spatial kernel (1,d,d), temporal kernel (t,1,1)
+    assert params["spatial"]["kernel"].shape[:3] == (1, 3, 3)
+    assert params["temporal"]["kernel"].shape[:3] == (3, 1, 1)
+    mid = factored_channels(8, 16, 3, 3)
+    assert params["spatial"]["kernel"].shape[-1] == mid
+    assert params["temporal"]["kernel"].shape[-2:] == (mid, 16)
+
+
+def test_full_net_output_and_downsampling():
+    m = R2Plus1DClassifier(num_classes=11, layer_sizes=(1, 1, 1, 1),
+                           dtype=DTYPE)
+    x = jnp.zeros((2, 4, 32, 32, 3))
+    v = jax.jit(lambda k: m.init(k, x, train=False))(jax.random.key(0))
+    out = m.apply(v, x, train=False)
+    assert out.shape == (2, 11)
+    assert out.dtype == jnp.float32
+    params = v["params"]["net"]
+    assert {"conv1", "stem_bn", "conv2", "conv3", "conv4",
+            "conv5"} <= set(params.keys())
+    assert "linear" in v["params"]
+
+
+def test_partial_range_shapes_chain():
+    # outputs of [1..k] must match the declared input of layer k+1
+    # (channel axis; spatial extent here is scaled down 112->28)
+    x = jnp.zeros((1, 8, 28, 28, 3))
+    for end in (1, 2, 3, 4):
+        m = R2Plus1DNet(start=1, end=end, layer_sizes=(1, 1, 1, 1),
+                        dtype=DTYPE)
+        v = jax.jit(lambda k, mm=m: mm.init(k, x, train=False))(
+            jax.random.key(0))
+        out = m.apply(v, x, train=False)
+        expected_c = LAYER_INPUT_SHAPES[end + 1][-1]
+        assert out.shape[-1] == expected_c
+        # temporal halving starts at layer 3
+        expected_t = {1: 8, 2: 8, 3: 4, 4: 2}[end]
+        assert out.shape[1] == expected_t
+
+
+def test_middle_range_accepts_feature_input():
+    m = R2Plus1DNet(start=3, end=4, layer_sizes=(1, 1, 1, 1), dtype=DTYPE)
+    x = jnp.zeros((2, 4, 14, 14, 64))  # layer-3 input channels
+    v = jax.jit(lambda k: m.init(k, x, train=False))(jax.random.key(0))
+    out = m.apply(v, x, train=False)
+    assert out.shape == (2, 1, 4, 4, 256)
+
+
+def test_no_head_without_final_layer():
+    m = R2Plus1DClassifier(start=1, end=2, layer_sizes=(1, 1, 1, 1),
+                           dtype=DTYPE)
+    x = jnp.zeros((1, 4, 16, 16, 3))
+    v = jax.jit(lambda k: m.init(k, x, train=False))(jax.random.key(0))
+    assert "linear" not in v["params"]
+    out = m.apply(v, x, train=False)
+    assert out.ndim == 5  # feature map, not logits
+
+
+def test_invalid_range_rejected():
+    with pytest.raises(ValueError):
+        R2Plus1DNet(start=0, end=3).init(
+            jax.random.key(0), jnp.zeros((1, 2, 8, 8, 3)))
+    with pytest.raises(ValueError):
+        R2Plus1DNet(start=4, end=2).init(
+            jax.random.key(0), jnp.zeros((1, 2, 8, 8, 3)))
+
+
+def test_train_mode_updates_batch_stats():
+    m = R2Plus1DClassifier(num_classes=5, layer_sizes=(1, 1, 1, 1),
+                           dtype=DTYPE)
+    x = jnp.ones((2, 4, 16, 16, 3))
+    v = jax.jit(lambda k: m.init(k, x, train=False))(jax.random.key(0))
+    out, mutated = m.apply(v, x, train=True, mutable=["batch_stats"])
+    assert out.shape == (2, 5)
+    old = jax.tree_util.tree_leaves(v["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))

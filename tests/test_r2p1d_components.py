@@ -1,0 +1,227 @@
+"""Sampler, decode backends, checkpoint filtering, host-side stages."""
+
+import numpy as np
+import pytest
+
+from rnb_tpu.decode import (SyntheticDecoder, Y4MDecoder, get_decoder,
+                            write_y4m)
+from rnb_tpu.models.r2p1d import checkpoint as ckpt
+from rnb_tpu.models.r2p1d.model import (MAX_CLIPS, LargeSmallSelector,
+                                        R2P1DAggregator,
+                                        R2P1DVideoPathIterator)
+from rnb_tpu.models.r2p1d.sampler import R2P1DSampler
+from rnb_tpu.stage import PaddedBatch
+from rnb_tpu.telemetry import TimeCard
+
+# ---------------- sampler ----------------
+
+
+def test_sampler_deterministic_per_video():
+    s = R2P1DSampler()
+    a = s.sample(200, video_id="v1")
+    b = s.sample(200, video_id="v1")
+    assert a == b
+    assert s.sample(200, video_id="v1") != s.sample(200, video_id="v7") or \
+        len(a) != len(s.sample(200, video_id="v7"))
+
+
+def test_sampler_skewed_distribution():
+    s = R2P1DSampler()
+    counts = [s.choose_num_clips(video_id="vid-%d" % i) for i in range(500)]
+    large = sum(1 for c in counts if c == 15)
+    assert set(counts) <= {1, 15}
+    assert 10 <= large <= 100  # ~9% of 500, loose bounds
+
+
+def test_sampler_spreads_clips():
+    s = R2P1DSampler()
+    starts = s.sample(160, video_id="x", num_clips=15)
+    assert len(starts) == 15
+    assert starts == sorted(starts)
+    assert all(st + 8 <= 160 for st in starts)
+    # even stride
+    diffs = {b - a for a, b in zip(starts, starts[1:])}
+    assert diffs == {160 // 15}
+
+
+def test_sampler_shrinks_for_short_videos():
+    s = R2P1DSampler()
+    starts = s.sample(40, video_id="x", num_clips=15)
+    assert len(starts) == 5  # floor(40 / 8)
+    assert all(st + 8 <= 40 for st in starts)
+    with pytest.raises(ValueError):
+        s.sample(4, video_id="x")
+
+
+# ---------------- decode ----------------
+
+
+def test_synthetic_decoder_deterministic():
+    d = SyntheticDecoder()
+    n = d.num_frames("synth://video-3")
+    assert 128 <= n <= 360
+    a = d.decode_clips("synth://video-3", [0, 10], 8)
+    b = d.decode_clips("synth://video-3", [0, 10], 8)
+    assert a.shape == (2, 8, 112, 112, 3)
+    assert a.dtype == np.uint8
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a[0], a[1])
+
+
+def test_y4m_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (12, 24, 32, 3), dtype=np.uint8)
+    path = str(tmp_path / "clip.y4m")
+    write_y4m(path, frames)
+    d = Y4MDecoder()
+    assert d.num_frames(path) == 12
+    out = d.decode_clips(path, [0], consecutive_frames=4, width=32,
+                         height=24)
+    assert out.shape == (1, 4, 24, 32, 3)
+    # RGB->YUV->RGB roundtrip at 4:4:4 is near-lossless
+    err = np.abs(out[0, 0].astype(int) - frames[0].astype(int))
+    assert err.mean() < 2.0
+
+
+def test_y4m_resize(tmp_path):
+    frames = np.full((9, 20, 20, 3), 200, dtype=np.uint8)
+    path = str(tmp_path / "c.y4m")
+    write_y4m(path, frames)
+    out = Y4MDecoder().decode_clips(path, [0, 1], consecutive_frames=8,
+                                    width=112, height=112)
+    assert out.shape == (2, 8, 112, 112, 3)
+    # clip 2 starting at frame 1 clamps reads to the last frame
+    assert np.abs(out.astype(int) - 200).max() <= 3
+
+
+def test_get_decoder_dispatch(tmp_path):
+    assert isinstance(get_decoder("synth://x"), SyntheticDecoder)
+    assert isinstance(get_decoder(str(tmp_path / "missing.mp4")),
+                      SyntheticDecoder)
+    p = tmp_path / "real.y4m"
+    write_y4m(str(p), np.zeros((1, 8, 8, 3), np.uint8))
+    assert isinstance(get_decoder(str(p)), Y4MDecoder)
+    q = tmp_path / "real.mp4"
+    q.write_bytes(b"xxxx")
+    with pytest.raises(ValueError, match="no decode backend"):
+        get_decoder(str(q))
+
+
+# ---------------- checkpoint ----------------
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _tiny_vars():
+    return ckpt.init_variables(seed=1, num_classes=7,
+                               layer_sizes=(1, 1, 1, 1))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    v = _tiny_vars()
+    path = str(tmp_path / "ck.msgpack")
+    ckpt.save_checkpoint(path, v)
+    loaded = ckpt.load_checkpoint(path)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(v),
+                    jax.tree_util.tree_leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_filter_layer_range():
+    v = _tiny_vars()
+    mid = ckpt.filter_layer_range(v, 2, 4)
+    assert set(mid["params"]["net"].keys()) == {"conv2", "conv3", "conv4"}
+    assert "linear" not in mid["params"]
+    head = ckpt.filter_layer_range(v, 5, 5)
+    assert set(head["params"]["net"].keys()) == {"conv5"}
+    assert "linear" in head["params"]
+    stem = ckpt.filter_layer_range(v, 1, 1)
+    assert set(stem["params"]["net"].keys()) == {"conv1", "stem_bn"}
+    assert "batch_stats" in mid
+    with pytest.raises(ValueError):
+        ckpt.filter_layer_range(v, 0, 9)
+
+
+def test_ensure_checkpoint_idempotent(tmp_path):
+    path = str(tmp_path / "full.msgpack")
+    p1 = ckpt.ensure_checkpoint(path)
+    mtime = __import__("os").path.getmtime(p1)
+    p2 = ckpt.ensure_checkpoint(path)
+    assert p1 == p2 == path
+    assert __import__("os").path.getmtime(p2) == mtime
+
+
+# ---------------- host-side stages ----------------
+
+
+def _logits_batch(valid, value):
+    data = np.zeros((MAX_CLIPS, 400), np.float32)
+    data[:valid] = value
+    return (PaddedBatch(data, valid),)
+
+
+def test_aggregator_waits_then_merges():
+    agg = R2P1DAggregator(device=None, aggregate=3)
+    parent = TimeCard(42)
+    parent.record("enqueue")
+    outs = []
+    for seg in range(3):
+        tc = parent.fork(seg)
+        tc.record("net")
+        # segment logits: one-hot-ish mass on class `seg`
+        arr = np.zeros((MAX_CLIPS, 400), np.float32)
+        arr[0, seg] = float(seg + 1)
+        outs.append(agg((PaddedBatch(arr, 1),), None, tc))
+    assert outs[0] == (None, None, None)
+    assert outs[1] == (None, None, None)
+    tensors, pred, merged = outs[2]
+    assert tensors is None
+    assert pred == 2  # class 2 got the largest summed logit
+    assert merged.id == 42
+    assert "net-0" in merged.timings and "net-2" in merged.timings
+    assert agg._pending == {}
+
+
+def test_aggregator_ignores_padding_rows():
+    agg = R2P1DAggregator(device=None, aggregate=1)
+    arr = np.zeros((MAX_CLIPS, 400), np.float32)
+    arr[0, 7] = 1.0
+    arr[5, 3] = 100.0  # padding row beyond valid=1 must be ignored
+    tc = TimeCard(0)
+    _, pred, _ = agg((PaddedBatch(arr, 1),), None, tc)
+    assert pred == 7
+
+
+def test_large_small_selector():
+    sel = LargeSmallSelector(2)
+    small = TimeCard(0)
+    small.num_clips = 1
+    large = TimeCard(1)
+    large.num_clips = MAX_CLIPS
+    assert sel.select(None, None, small) == 0
+    assert sel.select(None, None, large) == 1
+    with pytest.raises(ValueError):
+        LargeSmallSelector(3)
+
+
+def test_video_path_iterator_cycles_synthetic():
+    it = iter(R2P1DVideoPathIterator(num_synthetic=3))
+    seen = [next(it) for _ in range(7)]
+    assert seen[0].startswith("synth://")
+    assert seen[0] == seen[3] == seen[6]
+
+
+def test_video_path_iterator_scans_tree(tmp_path):
+    from rnb_tpu.decode import write_y4m as w
+    (tmp_path / "labelA").mkdir()
+    (tmp_path / "labelB").mkdir()
+    w(str(tmp_path / "labelA" / "v0.y4m"),
+      np.zeros((1, 8, 8, 3), np.uint8))
+    w(str(tmp_path / "labelB" / "v1.y4m"),
+      np.zeros((1, 8, 8, 3), np.uint8))
+    it = R2P1DVideoPathIterator(root=str(tmp_path))
+    assert len(it._videos) == 2
+    assert all(v.endswith(".y4m") for v in it._videos)
