@@ -1,0 +1,243 @@
+"""Benchmark orchestrator: launch a pipeline job end-to-end.
+
+CLI parity with the reference launcher (benchmark.py:127-305):
+``python -m rnb_tpu.benchmark -mi <ms> -b <batch> -v <videos>
+-qs <queue-size> -c <config.json> [--check]`` — plus TPU-runtime
+extras: ``--platform cpu`` forces the virtual-CPU backend (useful with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``), and
+``--log-base`` relocates the log directory.
+
+One controller process owns everything: it validates the config against
+the visible JAX devices (replacing the reference's NVML free-GPU probe,
+benchmark.py:97-125), builds the channel fabric, spawns the client and
+one executor thread per (step, group, device instance), fences them all
+with start/finish barriers so model compile/warm-up stays out of the
+measured window (benchmark.py:276-288), and writes ``log-meta.txt``
+plus a copy of the pipeline config into ``logs/<job_id>/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Any, Dict, Optional
+
+from rnb_tpu.arg_utils import nonnegative_int, positive_int
+
+BARRIER_TIMEOUT_S = 1800.0  # generous: first TPU compile can be slow
+
+
+@dataclass
+class BenchmarkResult:
+    job_id: str
+    total_time_s: float
+    num_videos: int
+    termination_flag: int
+    throughput_vps: float
+    log_dir: str
+
+
+def run_benchmark(config_path: str,
+                  mean_interval_ms: int = 3,
+                  batch_size: int = 1,
+                  num_videos: int = 2000,
+                  queue_size: int = 50000,
+                  log_base: str = "logs",
+                  print_progress: bool = True,
+                  seed: Optional[int] = None,
+                  job_id: Optional[str] = None) -> BenchmarkResult:
+    """Programmatic entry used by the CLI, tests and bench.py."""
+    from rnb_tpu.client import bulk_client, poisson_client
+    from rnb_tpu.config import load_config
+    from rnb_tpu.control import (ChannelFabric, InferenceCounter,
+                                 TerminationState)
+    from rnb_tpu.runner import RunnerContext, runner
+    from rnb_tpu.telemetry import logmeta, logroot
+
+    config = load_config(config_path)
+    config.check_devices()
+
+    if job_id is None:
+        job_id = "%s-mi%d-b%d-v%d-qs%d" % (
+            datetime.today().strftime("%y%m%d_%H%M%S"), mean_interval_ms,
+            batch_size, num_videos, queue_size)
+
+    num_runners = config.num_runners
+    bar_total = num_runners + 2  # runners + client + this controller
+    sta_bar = threading.Barrier(bar_total, timeout=BARRIER_TIMEOUT_S)
+    fin_bar = threading.Barrier(bar_total, timeout=BARRIER_TIMEOUT_S)
+    counter = InferenceCounter()
+    termination = TerminationState()
+
+    # bulk mode pre-enqueues everything; size the queues accordingly
+    # (reference benchmark.py:209 — but unlike the reference, account
+    # for segmentation fan-out: a step with num_segments=k multiplies
+    # the messages in flight downstream of it)
+    if mean_interval_ms > 0:
+        effective_queue_size = queue_size
+    else:
+        seg_factor = 1
+        for step in config.steps:
+            seg_factor *= step.num_segments
+        effective_queue_size = num_videos * seg_factor + num_runners + 1
+    fabric = ChannelFabric(config, effective_queue_size)
+
+    threads = []
+    if mean_interval_ms > 0:
+        client_args = (config.video_path_iterator,
+                       fabric.get_filename_queue(), mean_interval_ms,
+                       termination, sta_bar, fin_bar, seed)
+        client_impl = poisson_client
+    else:
+        client_args = (config.video_path_iterator,
+                       fabric.get_filename_queue(), num_videos,
+                       termination, sta_bar, fin_bar, seed)
+        client_impl = bulk_client
+    threads.append(threading.Thread(target=client_impl, args=client_args,
+                                    name="client", daemon=True))
+
+    for step_idx, step in enumerate(config.steps):
+        is_final = step_idx == config.num_steps - 1
+        for group_idx, group in enumerate(step.groups):
+            model_kwargs = step.kwargs_for_group(group_idx)
+            for instance_idx, device in enumerate(group.devices):
+                in_queue, out_queues = fabric.get_queues(step_idx,
+                                                         group_idx)
+                ctx = RunnerContext(
+                    in_queue=in_queue,
+                    out_queues=out_queues,
+                    queue_selector_path=group.queue_selector,
+                    print_progress=(is_final and group_idx == 0
+                                    and instance_idx == 0
+                                    and print_progress),
+                    job_id=job_id,
+                    device=device,
+                    group_idx=group_idx,
+                    instance_idx=instance_idx,
+                    counter=counter,
+                    num_videos=num_videos,
+                    termination=termination,
+                    step_idx=step_idx,
+                    sta_bar=sta_bar,
+                    fin_bar=fin_bar,
+                    model_class_path=step.model,
+                    num_segments=step.num_segments,
+                    input_rings=fabric.get_input_rings(step_idx, group_idx),
+                    output_ring=fabric.get_output_ring(step_idx, group_idx,
+                                                       instance_idx),
+                    sync_outputs=not step.async_dispatch,
+                    log_base=log_base,
+                    model_kwargs=model_kwargs,
+                )
+                threads.append(threading.Thread(
+                    target=runner, args=(ctx,),
+                    name="runner-s%d-g%d-i%d" % (step_idx, group_idx,
+                                                 instance_idx),
+                    daemon=True))
+
+    for t in threads:
+        t.start()
+
+    sta_bar.wait()
+    time_start = time.time()
+    if print_progress:
+        print("START! %f" % time_start)
+
+    fin_bar.wait()
+    time_end = time.time()
+    total_time = time_end - time_start
+    if print_progress:
+        print("FINISH! %f" % time_end)
+        print("Time: %f sec" % total_time)
+        print("Number of videos: %d videos" % num_videos)
+
+    for t in threads:
+        t.join(timeout=60)
+
+    args_repr = ("Namespace(mean_interval_ms=%d, batch_size=%d, videos=%d, "
+                 "queue_size=%d, config_file_path=%r)"
+                 % (mean_interval_ms, batch_size, num_videos, queue_size,
+                    config_path))
+    with open(logmeta(job_id, base=log_base), "w") as f:
+        f.write("Args: %s\n" % args_repr)
+        f.write("%f %f\n" % (time_start, time_end))
+        f.write("Termination flag: %d\n" % termination.value)
+    shutil.copyfile(config_path,
+                    os.path.join(logroot(job_id, base=log_base),
+                                 os.path.basename(config_path)))
+
+    return BenchmarkResult(
+        job_id=job_id,
+        total_time_s=total_time,
+        num_videos=num_videos,
+        termination_flag=int(termination.value),
+        throughput_vps=(counter.value / total_time if total_time > 0
+                        else 0.0),
+        log_dir=logroot(job_id, base=log_base),
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="TPU-native streaming video-analytics benchmark")
+    parser.add_argument("-mi", "--mean_interval_ms",
+                        help="Mean request interval (Poisson), ms; "
+                             "0 = bulk max-throughput mode",
+                        type=nonnegative_int, default=3)
+    parser.add_argument("-b", "--batch_size",
+                        help="Video batch size per replica",
+                        type=positive_int, default=1)
+    parser.add_argument("-v", "--videos",
+                        help="Total number of videos to run",
+                        type=positive_int, default=2000)
+    parser.add_argument("-qs", "--queue_size",
+                        help="Max size of inter-stage queues",
+                        type=positive_int, default=50000)
+    parser.add_argument("-c", "--config_file_path",
+                        help="Pipeline configuration JSON",
+                        type=str, default="configs/r2p1d-whole.json")
+    parser.add_argument("--check", action="store_true",
+                        help="Quick import smoke test, then exit")
+    parser.add_argument("--platform", choices=["auto", "cpu"],
+                        default="auto",
+                        help="'cpu' forces the (virtual) CPU backend")
+    parser.add_argument("--log-base", type=str, default="logs")
+    parser.add_argument("--seed", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.check:
+        import jax  # noqa: F401
+        import flax  # noqa: F401
+        from rnb_tpu import control, runner, client  # noqa: F401
+        from rnb_tpu.models.r2p1d import model  # noqa: F401
+        print("rnb_tpu is ready to go!")
+        return 0
+
+    print("Args:", args)
+    result = run_benchmark(
+        config_path=args.config_file_path,
+        mean_interval_ms=args.mean_interval_ms,
+        batch_size=args.batch_size,
+        num_videos=args.videos,
+        queue_size=args.queue_size,
+        log_base=args.log_base,
+        seed=args.seed,
+    )
+    print("Throughput: %.3f videos/s" % result.throughput_vps)
+    print("Logs: %s" % result.log_dir)
+    return 0 if result.termination_flag == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

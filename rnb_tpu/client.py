@@ -1,0 +1,101 @@
+"""Load generators: the request streams that drive a pipeline.
+
+``poisson_client`` emits one video request per draw of an exponential
+inter-arrival time (mean ``mean_interval_ms``) — the open-loop streaming
+workload. ``bulk_client`` enqueues ``num_videos`` requests as fast as
+possible — the max-throughput mode selected by ``-mi 0``. Both stamp a
+fresh TimeCard (``enqueue_filename``) per request and treat a full
+filename queue as a fatal configuration failure, not backpressure.
+
+Capability parity with the reference clients (client.py:11-106), as
+threads in the controller process instead of a separate OS process.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import traceback
+from typing import Optional
+
+import numpy as np
+
+from rnb_tpu.control import NUM_EXIT_MARKERS, TerminationFlag, \
+    TerminationState
+from rnb_tpu.telemetry import TimeCard
+from rnb_tpu.utils.class_utils import load_class
+
+
+def _drain(filename_queue: "queue.Queue") -> None:
+    for _ in range(NUM_EXIT_MARKERS):
+        try:
+            filename_queue.put_nowait(None)
+        except queue.Full:
+            return
+
+
+def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
+            termination: TerminationState, sta_bar: threading.Barrier,
+            fin_bar: threading.Barrier, *, mean_interval_ms: int,
+            num_videos: Optional[int], seed: Optional[int]) -> None:
+    try:
+        iterator = iter(load_class(video_path_iterator_path)())
+        rng = np.random.default_rng(seed)
+    except Exception:
+        traceback.print_exc()
+        termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
+        iterator = None
+
+    try:
+        sta_bar.wait()
+    except threading.BrokenBarrierError:
+        pass
+
+    try:
+        if iterator is not None:
+            video_count = 0
+            while not termination.terminated:
+                if num_videos is not None and video_count >= num_videos:
+                    break
+                video_path = next(iterator)
+                time_card = TimeCard(video_count)
+                time_card.record("enqueue_filename")
+                try:
+                    filename_queue.put_nowait((None, video_path, time_card))
+                except queue.Full:
+                    print("[WARNING] filename queue is full; aborting")
+                    termination.raise_flag(
+                        TerminationFlag.FILENAME_QUEUE_FULL)
+                    break
+                video_count += 1
+                if mean_interval_ms > 0:
+                    time.sleep(rng.exponential(mean_interval_ms / 1000.0))
+    except Exception:
+        traceback.print_exc()
+        termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
+    finally:
+        _drain(filename_queue)
+        try:
+            fin_bar.wait()
+        except threading.BrokenBarrierError:
+            pass
+
+
+def poisson_client(video_path_iterator_path, filename_queue,
+                   mean_interval_ms, termination, sta_bar, fin_bar,
+                   seed: Optional[int] = None) -> None:
+    """Open-loop Poisson stream until the job terminates
+    (reference client.py:11-59)."""
+    _client(video_path_iterator_path, filename_queue, termination, sta_bar,
+            fin_bar, mean_interval_ms=mean_interval_ms, num_videos=None,
+            seed=seed)
+
+
+def bulk_client(video_path_iterator_path, filename_queue, num_videos,
+                termination, sta_bar, fin_bar,
+                seed: Optional[int] = None) -> None:
+    """Enqueue num_videos requests immediately — max-throughput mode
+    (reference client.py:61-106)."""
+    _client(video_path_iterator_path, filename_queue, termination, sta_bar,
+            fin_bar, mean_interval_ms=0, num_videos=num_videos, seed=seed)

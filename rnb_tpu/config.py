@@ -28,6 +28,7 @@ from rnb_tpu.devices import DeviceSpec
 RESERVED_KEYWORDS = [
     "model", "queue_groups", "num_shared_tensors", "num_segments",
     "in_queue", "out_queues", "devices", "gpus", "queue_selector",
+    "async_dispatch",
 ]
 
 DEFAULT_QUEUE_SELECTOR = "rnb_tpu.selector.RoundRobinSelector"
@@ -67,6 +68,9 @@ class StepConfig:
     num_segments: int
     num_shared_tensors: Optional[int]
     extras: Dict[str, Any]
+    #: publish outputs without blocking on device completion (timing
+    #: then measures dispatch, not compute — see rnb_tpu.runner)
+    async_dispatch: bool = False
 
     def kwargs_for_group(self, group_idx: int) -> Dict[str, Any]:
         """Model-constructor kwargs: step extras overridden by group extras
@@ -207,12 +211,17 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                        step_idx, sorted(in_queues)))
         prev_out_queues = {q for g in groups for q in g.out_queues}
 
+        async_dispatch = step_raw.get("async_dispatch", False)
+        _expect(isinstance(async_dispatch, bool),
+                "%s: 'async_dispatch' must be a boolean" % where)
+
         step_extras = {k: v for k, v in step_raw.items()
                        if k not in RESERVED_KEYWORDS}
         steps.append(StepConfig(model=step_raw["model"], groups=groups,
                                 num_segments=num_segments,
                                 num_shared_tensors=num_shared_tensors,
-                                extras=step_extras))
+                                extras=step_extras,
+                                async_dispatch=async_dispatch))
 
     return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
                           steps=steps, raw=raw)

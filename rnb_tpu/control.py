@@ -48,12 +48,15 @@ NUM_EXIT_MARKERS = 10
 
 
 class TerminationFlag(enum.IntEnum):
-    """Job-wide termination reason codes (reference control.py:11-16)."""
+    """Job-wide termination reason codes (reference control.py:11-16;
+    INTERNAL_ERROR is ours — the reference had no code for a crashed
+    stage and could hang on one)."""
 
     UNSET = -1
     TARGET_NUM_VIDEOS_REACHED = 0
     FILENAME_QUEUE_FULL = 1
     FRAME_QUEUE_FULL = 2
+    INTERNAL_ERROR = 3
 
 
 class TerminationState:
@@ -81,6 +84,27 @@ class TerminationState:
     @property
     def terminated(self) -> bool:
         return self._value != TerminationFlag.UNSET
+
+
+class InferenceCounter:
+    """Locked global completed-inference counter driving the progress
+    display and the target-reached check (reference benchmark.py:199-205,
+    runner.py:176-196)."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def add(self, n: int) -> Tuple[int, int]:
+        """Add n; return (old, new) atomically."""
+        with self._lock:
+            old = self._value
+            self._value = old + n
+            return old, self._value
 
 
 #: Pointer passed through control queues instead of tensor payloads:

@@ -1,0 +1,272 @@
+"""The stage executor: one thread per (step, group, device instance).
+
+Capability parity with the reference's per-process hot loop
+(runner.py:5-271), re-designed for a single-controller TPU runtime:
+
+* stages are **threads**, not OS processes — JAX async dispatch plays
+  the role the private per-process CUDA stream played (reference
+  runner.py:41-44); device work from different stages overlaps while
+  threads block on queues;
+* the tensor hand-off is by reference: a Signal names a ring slot whose
+  payload is a tuple of immutable device arrays; "copy-out" is the
+  consuming stage's ``jax.device_put`` onto its own device (ICI on real
+  hardware), after which the slot is released for reuse;
+* segmentation splits the *valid* rows of each output row-wise
+  (remainder spread from the front: 11 rows over 3 segments -> 4/4/3,
+  reference runner.py:140-154), pads each segment back to the ring's
+  static segment shape, and forks the TimeCard per segment;
+* a crashed stage raises ``INTERNAL_ERROR`` instead of hanging the job
+  (the reference had no failure path for this).
+
+Synchronization fidelity: by default the executor blocks until a
+stage's device output is ready before stamping ``inference_finish`` and
+publishing downstream — the analog of the reference's
+``stream.synchronize()`` (runner.py:127-128), keeping latency
+decompositions honest. Setting ``async_dispatch=True`` on a step
+publishes as soon as XLA has the work queued; dataflow stays correct
+(consumers wait on the arrays' futures) and throughput improves, but
+``inference{i}`` spans then measure dispatch, not device compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, InferenceCounter,
+                             Signal, TerminationFlag, TerminationState)
+from rnb_tpu.devices import DeviceSpec
+from rnb_tpu.stage import PaddedBatch
+from rnb_tpu.telemetry import TimeCardList, TimeCardSummary, logname
+from rnb_tpu.utils.class_utils import load_class
+
+NUM_SUMMARY_SKIPS = 10  # steady-state summaries skip warm records
+QUEUE_POLL_S = 0.05
+
+
+@dataclass
+class RunnerContext:
+    """Everything one stage-executor thread needs."""
+
+    in_queue: "queue.Queue"
+    out_queues: Optional[List["queue.Queue"]]
+    queue_selector_path: str
+    print_progress: bool
+    job_id: str
+    device: DeviceSpec
+    group_idx: int
+    instance_idx: int
+    counter: InferenceCounter
+    num_videos: int
+    termination: TerminationState
+    step_idx: int
+    sta_bar: threading.Barrier
+    fin_bar: threading.Barrier
+    model_class_path: str
+    num_segments: int
+    input_rings: Optional[Dict[int, List[Optional[BufferRing]]]]
+    output_ring: Optional[BufferRing]
+    sync_outputs: bool = True
+    log_base: str = "logs"
+    model_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+def split_segments(payload, num_segments: int):
+    """Row-split each PaddedBatch's valid rows into ``num_segments``
+    per-segment PaddedBatches padded to the segment max shape.
+
+    Segment row counts follow the reference rule (runner.py:140-154):
+    ``divmod`` quotient everywhere, remainder spread from the front
+    (11 rows, 3 segments -> 4, 4, 3). Segments may be empty when the
+    batch has fewer valid rows than segments.
+    """
+    import jax.numpy as jnp
+    import math
+
+    if num_segments <= 1:
+        return [payload]
+    segments = []
+    for seg_idx in range(num_segments):
+        seg_payload = []
+        for pb in payload:
+            q, r = divmod(pb.valid, num_segments)
+            start = q * seg_idx + min(seg_idx, r)
+            end = q * (seg_idx + 1) + min(seg_idx + 1, r)
+            seg_rows = end - start
+            seg_max = math.ceil(pb.max_rows / num_segments)
+            chunk = pb.data[start:start + seg_max]
+            pad = seg_max - chunk.shape[0]
+            if pad > 0:
+                chunk = jnp.concatenate(
+                    [chunk, jnp.zeros((pad,) + tuple(chunk.shape[1:]),
+                                      chunk.dtype)], axis=0)
+            seg_payload.append(PaddedBatch(chunk, seg_rows))
+        segments.append(tuple(seg_payload))
+    return segments
+
+
+def _block_on(payload) -> None:
+    import jax
+    jax.block_until_ready([pb.data for pb in payload])
+
+
+def runner(ctx: RunnerContext) -> None:
+    """Thread entry: init the stage, run the hot loop, drain cleanly."""
+    summary = TimeCardSummary() if ctx.out_queues is None else None
+    progress_bar = None
+    try:
+        model_class = load_class(ctx.model_class_path)
+        model = model_class(ctx.device, **ctx.model_kwargs)
+
+        selector = None
+        if ctx.out_queues is not None:
+            selector_class = load_class(ctx.queue_selector_path)
+            selector = selector_class(len(ctx.out_queues))
+    except Exception:
+        traceback.print_exc()
+        ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
+        model = None
+
+    try:
+        ctx.sta_bar.wait()
+    except threading.BrokenBarrierError:
+        pass
+
+    if ctx.print_progress:
+        try:
+            from tqdm import tqdm
+            progress_bar = tqdm(total=ctx.num_videos)
+        except ImportError:
+            progress_bar = None
+
+    ring_counter = 0  # next output slot (reference runner.py:60-61)
+    old_counter_value = 0
+
+    try:
+        if model is not None:
+            while not ctx.termination.terminated:
+                try:
+                    item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    break  # end-of-stream marker
+
+                signal, non_tensors, time_card = item
+                time_card.add_device(ctx.device.label)
+                time_card.record("runner%d_start" % ctx.step_idx)
+
+                if signal is not None:
+                    ring = ctx.input_rings[signal.group_idx][
+                        signal.instance_idx]
+                    slot = ring.slots[signal.tensor_idx]
+                    # A free slot here means teardown already released
+                    # it under us — exit (reference runner.py:96-100).
+                    if slot.free.is_set() and ctx.termination.terminated:
+                        break
+                    tensors = slot.read()
+                    slot.release()
+                else:
+                    tensors = None
+
+                time_card.record("inference%d_start" % ctx.step_idx)
+                tensors_out, non_tensors_out, time_card = model(
+                    tensors, non_tensors, time_card)
+                if time_card is None:
+                    # stage swallowed the item (accumulating batcher /
+                    # aggregator) — nothing moves downstream
+                    continue
+                if ctx.sync_outputs and tensors_out:
+                    _block_on(tensors_out)
+                time_card.record("inference%d_finish" % ctx.step_idx)
+
+                if ctx.output_ring is not None:
+                    segments = split_segments(tensors_out, ctx.num_segments)
+                    for seg_idx, seg_payload in enumerate(segments):
+                        slot_idx = (ring_counter + seg_idx) \
+                            % len(ctx.output_ring)
+                        if not ctx.output_ring.wait_free(
+                                slot_idx, ctx.termination):
+                            break
+                        ctx.output_ring.slots[slot_idx].write(seg_payload)
+                    if ctx.termination.terminated:
+                        break
+
+                if ctx.out_queues is None:
+                    # final step: count completions, detect the target
+                    n = len(time_card) if isinstance(time_card,
+                                                     TimeCardList) else 1
+                    old, new = ctx.counter.add(n)
+                    if progress_bar is not None and new > old_counter_value:
+                        progress_bar.update(new - old_counter_value)
+                        old_counter_value = new
+                    if new >= ctx.num_videos:
+                        if old < ctx.num_videos:
+                            ctx.termination.raise_flag(
+                                TerminationFlag.TARGET_NUM_VIDEOS_REACHED)
+                        else:
+                            break  # someone else already hit the target
+                    cards = time_card.time_cards if isinstance(
+                        time_card, TimeCardList) else [time_card]
+                    for tc in cards:
+                        summary.register(tc)
+                else:
+                    out_idx = selector.select(tensors_out, non_tensors_out,
+                                              time_card)
+                    out_queue = ctx.out_queues[out_idx]
+                    try:
+                        for seg_idx in range(ctx.num_segments):
+                            forked = time_card.fork(seg_idx) \
+                                if ctx.num_segments > 1 else time_card
+                            if ctx.output_ring is not None:
+                                sig = Signal(ctx.group_idx,
+                                             ctx.instance_idx, ring_counter)
+                                ring_counter = (ring_counter + 1) \
+                                    % len(ctx.output_ring)
+                            else:
+                                sig = None
+                            out_queue.put_nowait(
+                                (sig, non_tensors_out, forked))
+                    except queue.Full:
+                        print("[WARNING] queue between steps %d and %d is "
+                              "full; aborting"
+                              % (ctx.step_idx, ctx.step_idx + 1))
+                        ctx.termination.raise_flag(
+                            TerminationFlag.FRAME_QUEUE_FULL)
+                        break
+    except Exception:
+        traceback.print_exc()
+        ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
+    finally:
+        # drain: mark end-of-stream downstream (reference runner.py:238-245)
+        if ctx.out_queues is not None:
+            for out_queue in ctx.out_queues:
+                for _ in range(NUM_EXIT_MARKERS):
+                    try:
+                        out_queue.put_nowait(None)
+                    except queue.Full:
+                        break
+        # wake any upstream producer blocked on our input rings
+        # (reference runner.py:247-253)
+        if ctx.input_rings is not None:
+            for rings in ctx.input_rings.values():
+                for ring in rings:
+                    if ring is not None:
+                        ring.release_all()
+        try:
+            ctx.fin_bar.wait()
+        except threading.BrokenBarrierError:
+            pass
+
+        if summary is not None:
+            with open(logname(ctx.job_id, ctx.device.label, ctx.group_idx,
+                              ctx.instance_idx, base=ctx.log_base),
+                      "w") as f:
+                summary.save_full_report(f)
+            if ctx.print_progress:
+                summary.print_summary(NUM_SUMMARY_SKIPS)
+                if progress_bar is not None:
+                    progress_bar.close()

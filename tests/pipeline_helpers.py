@@ -66,6 +66,23 @@ class TinySink(StageModel):
         return None, non_tensors, time_card
 
 
+class TinySlowSink(StageModel):
+    """Final stage that sleeps per item — forces upstream overflow."""
+
+    def __init__(self, device, delay_s=0.2, **kwargs):
+        super().__init__(device)
+        self.delay_s = float(delay_s)
+
+    @staticmethod
+    def output_shape():
+        return None
+
+    def __call__(self, tensors, non_tensors, time_card):
+        import time
+        time.sleep(self.delay_s)
+        return None, non_tensors, time_card
+
+
 class CountingPathIterator(VideoPathIterator):
     """Yields synthetic request ids forever: video-0, video-1, ..."""
 

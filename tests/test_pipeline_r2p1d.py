@@ -1,0 +1,48 @@
+"""End-to-end run of the real R(2+1)D stages (reduced geometry).
+
+One bounded integration test: Poisson client -> R2P1DLoader (synthetic
+decode, 2-frame clips) -> R2P1DRunner (1-block layers, 8 classes) ->
+logs, on two virtual devices. Uses the shared jit/param caches, so cost
+is one compile for the whole test session.
+"""
+
+import json
+import os
+
+
+from rnb_tpu.benchmark import run_benchmark
+from rnb_tpu.control import TerminationFlag
+
+
+def test_r2p1d_whole_pipeline(tmp_path):
+    cfg = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 8,
+             "max_clips": 2, "consecutive_frames": 2,
+             "num_clips_population": [1, 2], "weights": [3, 1],
+             "num_warmups": 1},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [1], "in_queue": 0}],
+             "start_index": 1, "end_index": 5,
+             "num_classes": 8, "layer_sizes": [1, 1, 1, 1],
+             "max_rows": 2, "consecutive_frames": 2, "num_warmups": 1},
+        ],
+    }
+    path = os.path.join(str(tmp_path), "whole.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    res = run_benchmark(path, mean_interval_ms=0, num_videos=4,
+                        queue_size=20, log_base=str(tmp_path / "logs"),
+                        print_progress=False)
+    assert res.termination_flag == TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    reports = [f for f in os.listdir(res.log_dir) if "group" in f]
+    with open(os.path.join(res.log_dir, reports[0])) as f:
+        lines = f.read().strip().split("\n")
+    header = lines[0].split()
+    assert "inference0_finish" in header  # loader stage timed
+    assert "inference1_finish" in header  # net stage timed
+    assert len(lines) - 1 >= 4
