@@ -66,6 +66,16 @@ class TinySink(StageModel):
         return None, non_tensors, time_card
 
 
+class TinyRoutedLoader(TinyLoader):
+    """Loader stamping num_clips: every 4th video 'large' (15 clips)."""
+
+    def __call__(self, tensors, non_tensors, time_card):
+        out = super().__call__(tensors, non_tensors, time_card)
+        vid = int(str(non_tensors).rsplit("-", 1)[-1])
+        time_card.num_clips = 15 if vid % 4 == 3 else 1
+        return out
+
+
 class TinySlowSink(StageModel):
     """Final stage that sleeps per item — forces upstream overflow."""
 
