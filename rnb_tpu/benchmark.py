@@ -93,12 +93,14 @@ def run_benchmark(config_path: str,
     if mean_interval_ms > 0:
         client_args = (config.video_path_iterator,
                        fabric.get_filename_queue(), mean_interval_ms,
-                       termination, sta_bar, fin_bar, seed)
+                       termination, sta_bar, fin_bar, seed,
+                       fabric.filename_num_markers)
         client_impl = poisson_client
     else:
         client_args = (config.video_path_iterator,
                        fabric.get_filename_queue(), num_videos,
-                       termination, sta_bar, fin_bar, seed)
+                       termination, sta_bar, fin_bar, seed,
+                       fabric.filename_num_markers)
         client_impl = bulk_client
     threads.append(threading.Thread(target=client_impl, args=client_args,
                                     name="client", daemon=True))
@@ -132,6 +134,8 @@ def run_benchmark(config_path: str,
                     input_rings=fabric.get_input_rings(step_idx, group_idx),
                     output_ring=fabric.get_output_ring(step_idx, group_idx,
                                                        instance_idx),
+                    out_trackers=fabric.get_out_trackers(step_idx,
+                                                         group_idx),
                     sync_outputs=not step.async_dispatch,
                     log_base=log_base,
                     model_kwargs=model_kwargs,

@@ -22,23 +22,16 @@ from typing import Optional
 import numpy as np
 
 from rnb_tpu.control import NUM_EXIT_MARKERS, TerminationFlag, \
-    TerminationState
+    TerminationState, send_exit_markers
 from rnb_tpu.telemetry import TimeCard
 from rnb_tpu.utils.class_utils import load_class
-
-
-def _drain(filename_queue: "queue.Queue") -> None:
-    for _ in range(NUM_EXIT_MARKERS):
-        try:
-            filename_queue.put_nowait(None)
-        except queue.Full:
-            return
 
 
 def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
             termination: TerminationState, sta_bar: threading.Barrier,
             fin_bar: threading.Barrier, *, mean_interval_ms: int,
-            num_videos: Optional[int], seed: Optional[int]) -> None:
+            num_videos: Optional[int], seed: Optional[int],
+            num_markers: int = NUM_EXIT_MARKERS) -> None:
     try:
         iterator = iter(load_class(video_path_iterator_path)())
         rng = np.random.default_rng(seed)
@@ -75,7 +68,7 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
         traceback.print_exc()
         termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
     finally:
-        _drain(filename_queue)
+        send_exit_markers(filename_queue, num_markers, termination)
         try:
             fin_bar.wait()
         except threading.BrokenBarrierError:
@@ -84,18 +77,21 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
 
 def poisson_client(video_path_iterator_path, filename_queue,
                    mean_interval_ms, termination, sta_bar, fin_bar,
-                   seed: Optional[int] = None) -> None:
+                   seed: Optional[int] = None,
+                   num_markers: int = NUM_EXIT_MARKERS) -> None:
     """Open-loop Poisson stream until the job terminates
     (reference client.py:11-59)."""
     _client(video_path_iterator_path, filename_queue, termination, sta_bar,
             fin_bar, mean_interval_ms=mean_interval_ms, num_videos=None,
-            seed=seed)
+            seed=seed, num_markers=num_markers)
 
 
 def bulk_client(video_path_iterator_path, filename_queue, num_videos,
                 termination, sta_bar, fin_bar,
-                seed: Optional[int] = None) -> None:
+                seed: Optional[int] = None,
+                num_markers: int = NUM_EXIT_MARKERS) -> None:
     """Enqueue num_videos requests immediately — max-throughput mode
     (reference client.py:61-106)."""
     _client(video_path_iterator_path, filename_queue, termination, sta_bar,
-            fin_bar, mean_interval_ms=0, num_videos=num_videos, seed=seed)
+            fin_bar, mean_interval_ms=0, num_videos=num_videos, seed=seed,
+            num_markers=num_markers)

@@ -107,6 +107,66 @@ class InferenceCounter:
             return old, self._value
 
 
+def send_exit_markers(target_queue: "queue.Queue",
+                      num_markers: int = NUM_EXIT_MARKERS,
+                      termination: Optional["TerminationState"] = None,
+                      timeout_s: float = 60.0) -> None:
+    """Enqueue ``num_markers`` end-of-stream ``None`` markers.
+
+    Markers must not be silently dropped: with the last-producer drain
+    protocol each edge gets exactly one marker attempt, so a transiently
+    full queue would otherwise lose the end-of-stream signal and hang
+    every downstream consumer until the barrier timeout. Retries with a
+    short blocking put until the queue drains, the job terminates, or a
+    generous deadline passes (a dead pipeline with no consumers left).
+    """
+    import time as _time
+    deadline = _time.monotonic() + timeout_s
+    for _ in range(num_markers):
+        while True:
+            try:
+                target_queue.put(None, timeout=0.05)
+                break
+            except queue.Full:
+                if termination is not None and termination.terminated:
+                    return
+                if _time.monotonic() > deadline:
+                    # markers could not be delivered — abort the job
+                    # rather than leave downstream consumers polling an
+                    # edge that will never see end-of-stream
+                    print("[WARNING] end-of-stream markers undeliverable "
+                          "for %.0fs; aborting" % timeout_s)
+                    if termination is not None:
+                        termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
+                    return
+
+
+class EdgeTracker:
+    """Producer countdown for one queue edge.
+
+    Exit markers (``None``) must never overtake real items: with
+    competing producer replicas feeding one queue, a fast replica that
+    finished and enqueued its markers could starve a downstream consumer
+    of a slower sibling's still-in-flight items (the consumer breaks on
+    the first ``None`` it pops). The fix over the reference's
+    fixed-10-markers heuristic (reference runner.py:238-245): every
+    producer on the edge decrements this tracker when it is done, and
+    only the *last* one enqueues the markers — by then every real item
+    is already in the queue ahead of them.
+    """
+
+    def __init__(self, num_producers: int, num_markers: int):
+        self._remaining = num_producers
+        self._lock = threading.Lock()
+        self.num_markers = num_markers
+
+    def producer_finished(self) -> bool:
+        """Record one producer's completion; True for the last one."""
+        with self._lock:
+            self._remaining -= 1
+            return self._remaining == 0
+
+
 #: Pointer passed through control queues instead of tensor payloads:
 #: names the producer (group, instance) and the ring slot index
 #: (reference control.py:209).
@@ -217,20 +277,41 @@ class ChannelFabric:
         # queues[step_idx][queue_idx] -> Queue shared by that step's
         # producers and the next step's consumers
         self.queues: List[Dict[int, "queue.Queue"]] = []
+        # trackers[step_idx][queue_idx] -> EdgeTracker for that edge
+        self.trackers: List[Dict[int, EdgeTracker]] = []
         # rings[step_idx][group_idx][instance_idx] -> BufferRing | None
         self.rings: List[List[List[Optional[BufferRing]]]] = []
+
+        #: the filename queue has exactly one producer (the client), so
+        #: it needs no countdown — just enough markers for step 0
+        self.filename_num_markers = max(
+            NUM_EXIT_MARKERS,
+            sum(len(g.devices) for g in pipeline.steps[0].groups))
 
         for step_idx, step in enumerate(pipeline.steps):
             is_final = step_idx == pipeline.num_steps - 1
 
             step_queues: Dict[int, "queue.Queue"] = {}
+            step_trackers: Dict[int, EdgeTracker] = {}
             if not is_final:
                 for group in step.groups:
                     for q_idx in group.out_queues:
                         if q_idx not in step_queues:
                             step_queues[q_idx] = queue.Queue(
                                 maxsize=queue_size)
+                for q_idx in step_queues:
+                    num_producers = sum(
+                        len(g.devices) for g in step.groups
+                        if q_idx in g.out_queues)
+                    num_consumers = sum(
+                        len(g.devices)
+                        for g in pipeline.steps[step_idx + 1].groups
+                        if g.in_queue == q_idx)
+                    step_trackers[q_idx] = EdgeTracker(
+                        num_producers,
+                        max(NUM_EXIT_MARKERS, num_consumers))
             self.queues.append(step_queues)
+            self.trackers.append(step_trackers)
 
             step_rings: List[List[Optional[BufferRing]]] = []
             shapes = None
@@ -276,6 +357,15 @@ class ChannelFabric:
             out_queues = [self.queues[step_idx][q] for q in group.out_queues]
         return in_queue, out_queues
 
+    def get_out_trackers(self, step_idx: int,
+                         group_idx: int) -> Optional[List[EdgeTracker]]:
+        """EdgeTrackers parallel to ``get_queues()[1]`` (None for the
+        final step)."""
+        if step_idx == self.pipeline.num_steps - 1:
+            return None
+        group = self.pipeline.steps[step_idx].groups[group_idx]
+        return [self.trackers[step_idx][q] for q in group.out_queues]
+
     def get_input_rings(self, step_idx: int,
                         group_idx: int) -> Optional[Dict[int, List[Optional[BufferRing]]]]:
         """Upstream rings a consumer may receive Signals into.
@@ -308,10 +398,3 @@ class ChannelFabric:
         return [r for step in self.rings for group in step for r in group
                 if r is not None]
 
-    def send_exit_markers(self, target_queue: "queue.Queue") -> None:
-        """Mark end-of-stream; Full is benign during teardown."""
-        for _ in range(NUM_EXIT_MARKERS):
-            try:
-                target_queue.put_nowait(None)
-            except queue.Full:
-                return

@@ -212,8 +212,13 @@ class R2P1DRunner(StageModel):
         if self.start_index == 1:
             shape[0] = int(consecutive_frames)
         self._steady_shape = (self.max_rows,) + tuple(shape)
+        # warm up with the dtype the pipeline actually flows (the
+        # loader's preprocess emits bfloat16) — a float32 dummy would
+        # compile a signature the hot loop never uses and pay the real
+        # compile on the first request instead
+        import jax.numpy as jnp
         dummy = jax.device_put(
-            np.zeros(self._steady_shape, np.float32), self._jax_device)
+            np.zeros(self._steady_shape, jnp.bfloat16), self._jax_device)
         for _ in range(num_warmups):
             jax.block_until_ready(self._apply(self._variables, dummy))
 

@@ -36,8 +36,9 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, InferenceCounter,
-                             Signal, TerminationFlag, TerminationState)
+from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
+                             InferenceCounter, Signal, TerminationFlag,
+                             TerminationState, send_exit_markers)
 from rnb_tpu.devices import DeviceSpec
 from rnb_tpu.stage import PaddedBatch
 from rnb_tpu.telemetry import TimeCardList, TimeCardSummary, logname
@@ -69,6 +70,7 @@ class RunnerContext:
     num_segments: int
     input_rings: Optional[Dict[int, List[Optional[BufferRing]]]]
     output_ring: Optional[BufferRing]
+    out_trackers: Optional[List[EdgeTracker]] = None
     sync_outputs: bool = True
     log_base: str = "logs"
     model_kwargs: Dict[str, Any] = field(default_factory=dict)
@@ -163,11 +165,12 @@ def runner(ctx: RunnerContext) -> None:
                     ring = ctx.input_rings[signal.group_idx][
                         signal.instance_idx]
                     slot = ring.slots[signal.tensor_idx]
-                    # A free slot here means teardown already released
-                    # it under us — exit (reference runner.py:96-100).
-                    if slot.free.is_set() and ctx.termination.terminated:
-                        break
                     tensors = slot.read()
+                    if tensors is None:
+                        # an abort-path release_all() cleared the slot
+                        # between our queue pop and this read — exit
+                        # (reference runner.py:96-100)
+                        break
                     slot.release()
                 else:
                     tensors = None
@@ -241,17 +244,25 @@ def runner(ctx: RunnerContext) -> None:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
     finally:
-        # drain: mark end-of-stream downstream (reference runner.py:238-245)
+        # drain: the LAST producer on each edge marks end-of-stream, so
+        # markers can never overtake a slower sibling replica's real
+        # items (improves on reference runner.py:238-245 which let any
+        # replica enqueue markers immediately)
         if ctx.out_queues is not None:
-            for out_queue in ctx.out_queues:
-                for _ in range(NUM_EXIT_MARKERS):
-                    try:
-                        out_queue.put_nowait(None)
-                    except queue.Full:
-                        break
-        # wake any upstream producer blocked on our input rings
-        # (reference runner.py:247-253)
-        if ctx.input_rings is not None:
+            for q_idx, out_queue in enumerate(ctx.out_queues):
+                tracker = (ctx.out_trackers[q_idx]
+                           if ctx.out_trackers is not None else None)
+                if tracker is None or tracker.producer_finished():
+                    markers = (tracker.num_markers if tracker is not None
+                               else NUM_EXIT_MARKERS)
+                    send_exit_markers(out_queue, markers, ctx.termination)
+        # on abort only: wake any upstream producer blocked on our input
+        # rings (reference runner.py:247-253). On a clean end-of-stream
+        # drain every upstream producer has already finished (markers
+        # come only after the last one), and a sibling replica may still
+        # hold an unread Signal — releasing here would clear its slot
+        # under it.
+        if ctx.input_rings is not None and ctx.termination.terminated:
             for rings in ctx.input_rings.values():
                 for ring in rings:
                     if ring is not None:

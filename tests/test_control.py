@@ -173,11 +173,38 @@ def test_fabric_segmented_ring_shapes():
 
 
 def test_exit_markers():
+    from rnb_tpu.control import send_exit_markers
+
     fabric = ChannelFabric(_three_step_config(), queue_size=100)
     q = fabric.get_filename_queue()
-    fabric.send_exit_markers(q)
+    send_exit_markers(q)
     assert q.qsize() == NUM_EXIT_MARKERS
-    # Full during teardown is benign
+    # a persistently full queue gives up after the deadline instead of
+    # dropping markers silently (they retry while consumers drain)
     small = queue.Queue(maxsize=2)
-    fabric.send_exit_markers(small)
+    send_exit_markers(small, timeout_s=0.2)
     assert small.qsize() == 2
+
+
+def test_exit_markers_retry_until_consumer_drains():
+    """Markers block-and-retry through a transiently full queue."""
+    import threading
+    import time
+
+    from rnb_tpu.control import send_exit_markers
+
+    q = queue.Queue(maxsize=3)
+    for i in range(3):
+        q.put(i)
+
+    def slow_drain():
+        for _ in range(3):
+            time.sleep(0.05)
+            q.get()
+
+    t = threading.Thread(target=slow_drain)
+    t.start()
+    send_exit_markers(q, num_markers=3, timeout_s=10.0)
+    t.join()
+    assert q.qsize() == 3
+    assert all(item is None for item in q.queue)

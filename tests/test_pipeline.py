@@ -126,6 +126,37 @@ def test_segmentation_with_aggregation(tmp_path):
     assert "inference2_finish" in header  # post-merge event, unsuffixed
 
 
+def test_exit_markers_never_overtake_items(tmp_path):
+    """Regression: with competing replicas feeding one queue, a fast
+    replica's end-of-stream markers must not starve the consumer of a
+    slower sibling's in-flight items. Only the LAST producer on an edge
+    may enqueue markers (EdgeTracker), so every run completes all
+    videos."""
+    cfg = {
+        "video_path_iterator": "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_segments": 2, "num_shared_tensors": 8},
+            {"model": "tests.pipeline_helpers.TinyDouble",
+             "queue_groups": [{"devices": [1, 2, 3, 4], "in_queue": 0,
+                               "out_queues": [1]}]},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DAggregator",
+             "queue_groups": [{"devices": [-1], "in_queue": 1}],
+             "aggregate": 2},
+        ],
+    }
+    path = _write_config(tmp_path, cfg)
+    for trial in range(5):
+        res = run_benchmark(path, mean_interval_ms=0, num_videos=40,
+                            queue_size=500,
+                            log_base=str(tmp_path / ("logs%d" % trial)),
+                            print_progress=False)
+        assert res.termination_flag == \
+            TerminationFlag.TARGET_NUM_VIDEOS_REACHED, \
+            "trial %d lost items (flag=%s)" % (trial, res.termination_flag)
+
+
 def test_filename_queue_overflow_aborts(tmp_path):
     cfg = {
         "video_path_iterator": "tests.pipeline_helpers.CountingPathIterator",
