@@ -212,13 +212,16 @@ class R2P1DRunner(StageModel):
         if self.start_index == 1:
             shape[0] = int(consecutive_frames)
         self._steady_shape = (self.max_rows,) + tuple(shape)
-        # warm up with the dtype the pipeline actually flows (the
-        # loader's preprocess emits bfloat16) — a float32 dummy would
-        # compile a signature the hot loop never uses and pay the real
-        # compile on the first request instead
+        # warm up with the dtype the pipeline actually flows: the
+        # loader's preprocess emits bfloat16 into layer 1, while an
+        # upstream network stage emits float32 activations
+        # (R2Plus1DClassifier casts its output) — a wrong-dtype dummy
+        # would compile a signature the hot loop never uses and pay the
+        # real compile on the first request instead
         import jax.numpy as jnp
+        warm_dtype = jnp.bfloat16 if self.start_index == 1 else jnp.float32
         dummy = jax.device_put(
-            np.zeros(self._steady_shape, jnp.bfloat16), self._jax_device)
+            np.zeros(self._steady_shape, warm_dtype), self._jax_device)
         for _ in range(num_warmups):
             jax.block_until_ready(self._apply(self._variables, dummy))
 

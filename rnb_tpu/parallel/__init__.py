@@ -1,0 +1,28 @@
+"""Multi-chip parallel execution: meshes, sharded steps, collectives.
+
+The reference scaled by *processes*: one OS process per GPU, replicas
+competing on queues, batches hand-split into segments and re-merged by a
+CPU aggregator (SURVEY.md §2.3). On TPU the idiomatic scaling unit is
+the **device mesh**: a stage runs once, jitted over a
+``jax.sharding.Mesh``, with XLA inserting ICI collectives where the
+sharding demands them. This package provides:
+
+* :mod:`rnb_tpu.parallel.mesh` — mesh construction and axis factoring;
+* :mod:`rnb_tpu.parallel.sharded` — the sharded inference step: videos
+  sharded over ``dp``, clips over ``sp`` with an on-device ``psum``
+  replacing the reference's host-side logit aggregator
+  (models/r2p1d/model.py:238-285);
+* :mod:`rnb_tpu.parallel.distributed` — multi-host (DCN) runtime
+  initialization, the capability slot the reference filled with
+  single-node torch.multiprocessing (benchmark.py:130-132).
+"""
+
+from rnb_tpu.parallel.mesh import (MeshSpec, build_mesh, factor_devices,
+                                   submeshes)
+from rnb_tpu.parallel.sharded import (ShardedInference,
+                                      make_sharded_inference)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "factor_devices", "submeshes",
+    "ShardedInference", "make_sharded_inference",
+]

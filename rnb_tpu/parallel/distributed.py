@@ -1,0 +1,88 @@
+"""Multi-host runtime initialization (DCN-scale distribution).
+
+The reference's "distributed backend" was single-node
+torch.multiprocessing: spawn-mode processes, pickle queues, CUDA-IPC
+tensors (SURVEY.md §2.4). The TPU-native equivalent splits cleanly in
+two:
+
+* **intra-slice (ICI)**: invisible to user code — XLA collectives
+  inserted by sharding annotations (see :mod:`rnb_tpu.parallel.sharded`);
+* **inter-host (DCN)**: ``jax.distributed`` — one controller process
+  per host, all hosts participating in every jitted collective over the
+  global mesh. This module wraps its initialization behind environment
+  variables so single-host runs (and the CPU test mesh) need no setup.
+
+Env contract (all optional; absence = single-process mode):
+  RNB_TPU_COORDINATOR   "host:port" of process 0
+  RNB_TPU_NUM_PROCESSES total process count
+  RNB_TPU_PROCESS_ID    this process's index
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def maybe_initialize(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Initialize ``jax.distributed`` when multi-host env/args are set.
+
+    Returns True when running distributed (after initialization), False
+    for single-process mode. Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("RNB_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("RNB_TPU_NUM_PROCESSES", "0")) \
+            or None
+    if process_id is None:
+        pid = os.environ.get("RNB_TPU_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if coordinator is None:
+        if num_processes is not None or process_id is not None:
+            raise RuntimeError(
+                "RNB_TPU_NUM_PROCESSES/RNB_TPU_PROCESS_ID are set but "
+                "RNB_TPU_COORDINATOR is not — refusing to fall back to "
+                "single-process mode in a partially-configured "
+                "multi-host launch")
+        return False
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the process that should write logs / print summaries."""
+    return process_index() == 0
+
+
+def global_mesh(axis_names=("dp", "sp"), axes=None):
+    """A mesh over every device of every participating host.
+
+    With multiple hosts the returned mesh spans hosts; shardings over it
+    make XLA route collectives over ICI within a slice and DCN across
+    slices — no NCCL/MPI-style plumbing in user code.
+    """
+    import jax
+    from rnb_tpu.parallel.mesh import build_mesh
+    return build_mesh(list(jax.devices()), axes=axes,
+                      axis_names=axis_names)

@@ -1,0 +1,145 @@
+"""Mesh construction and device-axis factoring.
+
+Pipeline configs name devices by index; parallel stages name *axes*
+(``dp`` — data/video replication, ``sp`` — clip/segment sharding).
+These helpers turn "this group owns devices [0..k)" into a
+``jax.sharding.Mesh`` with the requested axis split, and carve a global
+device list into disjoint per-stage sub-meshes (the TPU analog of the
+reference pinning each pipeline step to its own GPU set,
+reference benchmark.py:230-271).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class MeshSpec:
+    """A declarative mesh request: ordered {axis_name: size}.
+
+    Size ``-1`` on at most one axis means "whatever is left" after the
+    explicit axes divide the device count (mirrors reshape's -1).
+    """
+
+    def __init__(self, axes: Dict[str, int]):
+        if not axes:
+            raise ValueError("MeshSpec needs at least one axis")
+        wildcards = [a for a, s in axes.items() if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError("at most one mesh axis may be -1, got %r"
+                             % (axes,))
+        for a, s in axes.items():
+            if s != -1 and s < 1:
+                raise ValueError("mesh axis %r has invalid size %d" % (a, s))
+        self.axes = dict(axes)
+
+    def resolve(self, num_devices: int) -> Dict[str, int]:
+        """Concrete axis sizes for ``num_devices`` devices."""
+        sizes = dict(self.axes)
+        explicit = 1
+        wildcard = None
+        for a, s in sizes.items():
+            if s == -1:
+                wildcard = a
+            else:
+                explicit *= s
+        if wildcard is not None:
+            if num_devices % explicit != 0:
+                raise ValueError(
+                    "cannot fill axis %r: %d devices not divisible by %d"
+                    % (wildcard, num_devices, explicit))
+            sizes[wildcard] = num_devices // explicit
+        elif explicit != num_devices:
+            raise ValueError(
+                "mesh %r wants %d devices but group has %d"
+                % (self.axes, explicit, num_devices))
+        return sizes
+
+    def __repr__(self):
+        return "MeshSpec(%r)" % (self.axes,)
+
+
+def factor_devices(num_devices: int,
+                   axis_names: Sequence[str]) -> Dict[str, int]:
+    """Factor ``num_devices`` across ``axis_names`` as evenly as
+    possible, biasing larger factors toward the *earlier* axes.
+
+    Used when a caller asks for "a dp×sp mesh over n devices" without
+    caring about the exact split — e.g. ``dryrun_multichip``. 8 devices
+    over ("dp", "sp") -> {dp: 4, sp: 2}; over ("pp", "dp", "sp") ->
+    {pp: 2, dp: 2, sp: 2}; a prime count puts everything on the first
+    axis.
+    """
+    if num_devices < 1:
+        raise ValueError("need at least one device")
+    names = list(axis_names)
+    sizes = {a: 1 for a in names}
+    factors: List[int] = []
+    n = int(num_devices)
+    p = 2
+    while p * p <= n:
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        factors.append(n)
+    # LPT greedy: place prime factors largest-first onto the currently
+    # smallest axis — keeps the split as even as the factorization allows
+    for f in sorted(factors, reverse=True):
+        smallest = min(range(len(names)), key=lambda i: sizes[names[i]])
+        sizes[names[smallest]] *= f
+    # sort sizes descending onto the axis order so earlier axes are larger
+    ordered = sorted((sizes[a] for a in names), reverse=True)
+    return dict(zip(names, ordered))
+
+
+def build_mesh(devices: Optional[Sequence] = None,
+               axes: Optional[Dict[str, int]] = None,
+               axis_names: Sequence[str] = ("dp", "sp")):
+    """Build a ``jax.sharding.Mesh``.
+
+    ``devices`` defaults to all visible accelerator devices. ``axes``
+    gives explicit {name: size} (``-1`` allowed once); without it the
+    device count is auto-factored over ``axis_names``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = list(jax.devices())
+    devices = list(devices)
+    if axes is not None:
+        sizes = MeshSpec(axes).resolve(len(devices))
+    else:
+        sizes = factor_devices(len(devices), axis_names)
+    names = tuple(sizes.keys())
+    shape = tuple(sizes[a] for a in names)
+    grid = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(grid, names)
+
+
+def submeshes(devices: Sequence, stage_sizes: Sequence[int],
+              axes_per_stage: Sequence[Optional[Dict[str, int]]] = None):
+    """Carve ``devices`` into disjoint consecutive sub-meshes.
+
+    ``stage_sizes[i]`` devices go to stage i (the pipeline-parallel
+    split: each stage owns its own cores and hand-off between stages is
+    an ICI re-shard, the analog of the reference's per-step GPU lists).
+    Returns a list of Meshes.
+    """
+    devices = list(devices)
+    if sum(stage_sizes) > len(devices):
+        raise ValueError("stage sizes %r exceed %d devices"
+                         % (list(stage_sizes), len(devices)))
+    if axes_per_stage is None:
+        axes_per_stage = [None] * len(stage_sizes)
+    out = []
+    cursor = 0
+    for size, axes in zip(stage_sizes, axes_per_stage):
+        chunk = devices[cursor: cursor + size]
+        cursor += size
+        out.append(build_mesh(chunk, axes=axes))
+    return out

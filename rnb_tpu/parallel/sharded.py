@@ -1,0 +1,163 @@
+"""The sharded inference step: one jit over a dp×sp device mesh.
+
+This is the TPU-native generalization of the reference's scaling
+mechanics (SURVEY.md §2.3):
+
+* **dp** (data parallel) shards the *video* axis — what the reference
+  did with replica processes competing on one queue
+  (reference benchmark.py:248-271);
+* **sp** (segment parallel) shards the *clip* axis — what the
+  reference did with ``num_segments`` row-splitting, forked TimeCards
+  and a host-side aggregator summing logits per request
+  (reference runner.py:138-173, models/r2p1d/model.py:238-285). Here
+  the split, the compute and the merge all live inside one compiled
+  program: every ``sp`` member computes logits for its clip shard and a
+  ``psum`` over the ``sp`` axis reduces them on-chip over ICI — no host
+  round-trip, no queue hop, no aggregator stage.
+
+Variable clip counts (1..max_clips per video) are handled the same way
+the rest of the framework handles them: fixed max-shape batches plus a
+validity mask (reference control.py:34-39 kept as the shape idiom), so
+XLA compiles exactly once.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rnb_tpu.models.r2p1d import checkpoint as ckpt
+from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES, NUM_LAYERS,
+                                          R18_LAYER_SIZES,
+                                          R2Plus1DClassifier)
+
+
+class ShardedInference:
+    """Full R(2+1)D inference jitted once over a ``dp × sp`` mesh.
+
+    ``run(videos_u8, clip_mask)`` takes a uint8 batch of shape
+    ``(videos, max_clips, frames, H, W, 3)`` and a float mask
+    ``(videos, max_clips)`` (1.0 = valid clip) and returns per-video
+    aggregated logits ``(videos, num_classes)`` — already summed over
+    each video's valid clips and psum-reduced across the ``sp`` axis.
+
+    The video axis must divide the mesh's ``dp`` size and the clip axis
+    its ``sp`` size (fixed shapes; pad with masked rows).
+    """
+
+    def __init__(self, mesh, max_clips: int = 15,
+                 consecutive_frames: int = 8,
+                 frame_hw: int = 112,
+                 num_classes: int = KINETICS_CLASSES,
+                 layer_sizes: Sequence[int] = R18_LAYER_SIZES,
+                 dtype: Any = None,
+                 ckpt_path: Optional[str] = None,
+                 dp_axis: str = "dp", sp_axis: str = "sp",
+                 variables: Optional[Any] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if dp_axis not in mesh.axis_names or sp_axis not in mesh.axis_names:
+            raise ValueError("mesh %r lacks axis %r/%r"
+                             % (mesh.axis_names, dp_axis, sp_axis))
+        self.mesh = mesh
+        self.max_clips = int(max_clips)
+        self.consecutive_frames = int(consecutive_frames)
+        self.frame_hw = int(frame_hw)
+        self.num_classes = int(num_classes)
+        self.dp_axis = dp_axis
+        self.sp_axis = sp_axis
+        dtype = dtype or jnp.bfloat16
+        layer_sizes = tuple(layer_sizes)
+
+        sp_size = mesh.shape[sp_axis]
+        if self.max_clips % sp_size != 0:
+            raise ValueError(
+                "the sp axis size (%d) must divide max_clips=%d; pad the "
+                "clip axis up to a multiple (masked rows are free)"
+                % (sp_size, self.max_clips))
+
+        model = R2Plus1DClassifier(start=1, end=NUM_LAYERS,
+                                   num_classes=num_classes,
+                                   layer_sizes=layer_sizes, dtype=dtype)
+
+        if variables is None:
+            if (num_classes, layer_sizes) == (KINETICS_CLASSES,
+                                              tuple(R18_LAYER_SIZES)):
+                variables = ckpt.load_for_range(1, NUM_LAYERS, ckpt_path)
+            else:
+                variables = ckpt.init_variables(
+                    start=1, end=NUM_LAYERS, num_classes=num_classes,
+                    layer_sizes=layer_sizes)
+        replicated = NamedSharding(mesh, P())
+        self.variables = jax.device_put(variables, replicated)
+
+        self.batch_sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
+        self.logit_sharding = NamedSharding(mesh, P(dp_axis))
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        def step(variables, vids, mask):
+            # local shapes: vids (v, c, F, H, W, 3), mask (v, c)
+            v, c = vids.shape[0], vids.shape[1]
+            x = vids.reshape((v * c,) + vids.shape[2:])
+            x = x.astype(dtype) * (2.0 / 255.0) - 1.0
+            logits = model.apply(variables, x, train=False)
+            logits = logits.reshape(v, c, self.num_classes)
+            per_video = (logits * mask[..., None]).sum(axis=1)
+            return jax.lax.psum(per_video, sp_axis)
+
+        sharded = shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
+            out_specs=P(dp_axis))
+        self._run = jax.jit(sharded)
+
+    def batch_shape(self, num_videos: int) -> Tuple[int, ...]:
+        return (num_videos, self.max_clips, self.consecutive_frames,
+                self.frame_hw, self.frame_hw, 3)
+
+    def place(self, videos_u8: np.ndarray, valid_clips: Sequence[int]):
+        """Device-put a host batch + derive its mask, both sharded."""
+        import jax
+        mask = np.zeros(videos_u8.shape[:2], np.float32)
+        for i, n in enumerate(valid_clips):
+            mask[i, : int(n)] = 1.0
+        vids = jax.device_put(videos_u8, self.batch_sharding)
+        mask = jax.device_put(mask, self.batch_sharding)
+        return vids, mask
+
+    def run(self, vids, mask):
+        """-> per-video aggregated logits (videos, num_classes), fp32."""
+        return self._run(self.variables, vids, mask)
+
+    def predict(self, videos_u8: np.ndarray,
+                valid_clips: Sequence[int]) -> np.ndarray:
+        """Host convenience: class ids for one padded uint8 batch."""
+        vids, mask = self.place(videos_u8, valid_clips)
+        logits = self.run(vids, mask)
+        return np.asarray(logits).argmax(axis=-1)
+
+
+def make_sharded_inference(mesh=None, num_devices: Optional[int] = None,
+                           **kwargs) -> ShardedInference:
+    """Build a :class:`ShardedInference` over ``mesh`` (or an
+    auto-factored dp×sp mesh over ``num_devices`` / all devices)."""
+    if mesh is None:
+        import jax
+        from rnb_tpu.parallel.mesh import build_mesh
+        devices = list(jax.devices())
+        if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    "asked for %d devices but only %d are visible"
+                    % (num_devices, len(devices)))
+            devices = devices[:num_devices]
+        mesh = build_mesh(devices, axis_names=("dp", "sp"))
+    return ShardedInference(mesh, **kwargs)

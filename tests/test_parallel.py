@@ -1,0 +1,127 @@
+"""Mesh factoring + sharded dp/sp inference step on the virtual 8-device
+CPU mesh (conftest forces JAX_PLATFORMS=cpu with 8 devices)."""
+
+import numpy as np
+import pytest
+
+from rnb_tpu.parallel.mesh import (MeshSpec, build_mesh, factor_devices,
+                                   submeshes)
+from rnb_tpu.parallel.sharded import make_sharded_inference
+
+TINY = dict(max_clips=4, consecutive_frames=4, frame_hw=32,
+            num_classes=16, layer_sizes=(1, 1, 1, 1))
+
+
+def test_mesh_spec_resolve():
+    assert MeshSpec({"dp": 2, "sp": 4}).resolve(8) == {"dp": 2, "sp": 4}
+    assert MeshSpec({"dp": -1, "sp": 2}).resolve(8) == {"dp": 4, "sp": 2}
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": 3, "sp": 2}).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": -1, "sp": -1})
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": -1, "sp": 3}).resolve(8)
+
+
+def test_factor_devices():
+    f = factor_devices(8, ("dp", "sp"))
+    assert f["dp"] * f["sp"] == 8 and f["dp"] >= f["sp"]
+    f = factor_devices(8, ("pp", "dp", "sp"))
+    assert f == {"pp": 2, "dp": 2, "sp": 2}
+    f = factor_devices(7, ("dp", "sp"))
+    assert f == {"dp": 7, "sp": 1}
+    f = factor_devices(12, ("dp", "sp"))
+    assert f == {"dp": 4, "sp": 3}  # even LPT split, not {6, 2}
+    f = factor_devices(1, ("dp", "sp"))
+    assert f == {"dp": 1, "sp": 1}
+
+
+def test_build_mesh_and_submeshes():
+    import jax
+    mesh = build_mesh(axes={"dp": 2, "sp": 4})
+    assert mesh.shape == {"dp": 2, "sp": 4}
+    meshes = submeshes(jax.devices(), [4, 4],
+                       [{"dp": 2, "sp": 2}, {"dp": -1, "sp": 1}])
+    assert meshes[0].shape == {"dp": 2, "sp": 2}
+    assert meshes[1].shape == {"dp": 4, "sp": 1}
+    seen = {d for m in meshes for d in m.devices.flat}
+    assert len(seen) == 8
+    with pytest.raises(ValueError):
+        submeshes(jax.devices(), [6, 4])
+
+
+def _reference_logits(si, videos_u8, valid_clips):
+    """Unsharded replay of the same math for comparison."""
+    import jax.numpy as jnp
+    from rnb_tpu.models.r2p1d.network import R2Plus1DClassifier
+    model = R2Plus1DClassifier(num_classes=TINY["num_classes"],
+                               layer_sizes=TINY["layer_sizes"],
+                               dtype=jnp.bfloat16)
+    v, c = videos_u8.shape[:2]
+    x = videos_u8.reshape((v * c,) + videos_u8.shape[2:])
+    x = jnp.asarray(x, jnp.bfloat16) * (2.0 / 255.0) - 1.0
+    logits = np.asarray(model.apply(si.variables, x, train=False))
+    logits = logits.reshape(v, c, -1)
+    mask = np.zeros((v, c), np.float32)
+    for i, n in enumerate(valid_clips):
+        mask[i, :n] = 1.0
+    return (logits * mask[..., None]).sum(axis=1)
+
+
+def test_sharded_inference_matches_unsharded():
+    si = make_sharded_inference(mesh=build_mesh(axes={"dp": 4, "sp": 2}),
+                                **TINY)
+    rng = np.random.default_rng(0)
+    videos = rng.integers(0, 256, si.batch_shape(8), dtype=np.uint8)
+    valid = [1, 4, 2, 3, 4, 1, 2, 3]
+    vids, mask = si.place(videos, valid)
+    got = np.asarray(si.run(vids, mask))
+    assert got.shape == (8, TINY["num_classes"])
+    want = _reference_logits(si, videos, valid)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    # masked clips must not influence the result: scribble on padding
+    scribbled = videos.copy()
+    scribbled[0, 1:] = 255
+    vids2, mask2 = si.place(scribbled, valid)
+    got2 = np.asarray(si.run(vids2, mask2))
+    np.testing.assert_allclose(got2[0], got[0], rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_inference_predict_deterministic():
+    import jax
+    si = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:4], axes={"dp": 2, "sp": 2}),
+        **TINY)
+    rng = np.random.default_rng(1)
+    videos = rng.integers(0, 256, si.batch_shape(4), dtype=np.uint8)
+    p1 = si.predict(videos, [4, 4, 4, 4])
+    p2 = si.predict(videos, [4, 4, 4, 4])
+    assert p1.shape == (4,)
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_sharded_inference_rejects_bad_clip_split():
+    with pytest.raises(ValueError):
+        make_sharded_inference(mesh=build_mesh(axes={"dp": 4, "sp": 2}),
+                               max_clips=15, consecutive_frames=4,
+                               frame_hw=32, num_classes=16,
+                               layer_sizes=(1, 1, 1, 1))
+
+
+def test_distributed_single_process_mode(monkeypatch):
+    from rnb_tpu.parallel import distributed
+    monkeypatch.delenv("RNB_TPU_COORDINATOR", raising=False)
+    assert distributed.maybe_initialize() is False
+    assert distributed.process_count() == 1
+    assert distributed.is_primary()
+    mesh = distributed.global_mesh()
+    assert mesh.devices.size == 8
+
+
+def test_distributed_partial_env_raises(monkeypatch):
+    from rnb_tpu.parallel import distributed
+    monkeypatch.delenv("RNB_TPU_COORDINATOR", raising=False)
+    monkeypatch.setenv("RNB_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("RNB_TPU_PROCESS_ID", "1")
+    with pytest.raises(RuntimeError):
+        distributed.maybe_initialize()
