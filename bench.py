@@ -1,0 +1,63 @@
+"""Headline benchmark: videos/sec through the flagship pipeline.
+
+Reproduces the reference's benchmark methodology (SURVEY.md §6) on this
+framework: the 2-stage decode→R(2+1)D pipeline of
+``configs/r2p1d-whole.json`` driven in bulk (max-throughput) mode —
+the same topology behind the reference's only published number
+(11.3 videos/s on one GPU, reference README.md:176-178).
+
+Prints exactly ONE JSON line:
+  {"metric": "videos_per_sec", "value": N, "unit": "videos/s",
+   "vs_baseline": N / 11.3}
+
+Env knobs: RNB_BENCH_VIDEOS (default 500), RNB_BENCH_CONFIG,
+RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+#: reference README.md:176-178 — 500 videos / 44.249694 s on one GPU
+BASELINE_VIDEOS_PER_SEC = 500.0 / 44.249694
+
+
+def main() -> int:
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo_dir)
+    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "500"))
+    config = os.environ.get(
+        "RNB_BENCH_CONFIG",
+        os.path.join(repo_dir, "configs", "r2p1d-whole.json"))
+    mean_interval = int(os.environ.get("RNB_BENCH_MEAN_INTERVAL_MS", "0"))
+
+    from rnb_tpu.benchmark import run_benchmark
+
+    # everything the harness prints stays out of the one-line contract
+    with contextlib.redirect_stdout(io.StringIO()), \
+            contextlib.redirect_stderr(io.StringIO()):
+        result = run_benchmark(
+            config_path=config,
+            mean_interval_ms=mean_interval,
+            num_videos=num_videos,
+            log_base=os.environ.get("RNB_BENCH_LOG_BASE", "logs"),
+            print_progress=False,
+            seed=0,
+        )
+
+    value = result.throughput_vps
+    print(json.dumps({
+        "metric": "videos_per_sec",
+        "value": round(value, 3),
+        "unit": "videos/s",
+        "vs_baseline": round(value / BASELINE_VIDEOS_PER_SEC, 3),
+    }))
+    return 0 if result.termination_flag == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

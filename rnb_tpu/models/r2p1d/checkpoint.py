@@ -25,6 +25,7 @@ import numpy as np
 
 from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
                                           LAYER_INPUT_SHAPES, NUM_LAYERS,
+                                          R18_LAYER_SIZES,
                                           R2Plus1DClassifier)
 
 DEFAULT_CKPT_DIR = os.path.join(
@@ -120,3 +121,18 @@ def load_for_range(start: int, end: int,
     """Load the shared checkpoint filtered to [start..end]."""
     return filter_layer_range(load_checkpoint(ensure_checkpoint(path)),
                               start, end)
+
+
+def load_or_init(start: int, end: int,
+                 num_classes: int = KINETICS_CLASSES,
+                 layer_sizes=R18_LAYER_SIZES,
+                 path: Optional[str] = None) -> Dict[str, Any]:
+    """The one checkpoint policy every execution path shares: the
+    default architecture loads the shared (range-filtered) checkpoint;
+    any other architecture (tests, tiny dry runs) gets a fresh seeded
+    init."""
+    if (num_classes, tuple(layer_sizes)) == (KINETICS_CLASSES,
+                                             tuple(R18_LAYER_SIZES)):
+        return load_for_range(start, end, path)
+    return init_variables(start=start, end=end, num_classes=num_classes,
+                          layer_sizes=tuple(layer_sizes))

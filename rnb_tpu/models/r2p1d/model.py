@@ -78,14 +78,8 @@ def _shared_params(start: int, end: int, num_classes: int,
     with _cache_lock:
         params = _params_cache.get(key)
         if params is None:
-            if (num_classes, tuple(layer_sizes)) == (
-                    KINETICS_CLASSES, tuple(R18_LAYER_SIZES)):
-                variables = ckpt.load_for_range(start, end, ckpt_path)
-            else:
-                # non-default architecture (tests): fresh seeded init
-                variables = ckpt.init_variables(
-                    start=start, end=end, num_classes=num_classes,
-                    layer_sizes=layer_sizes)
+            variables = ckpt.load_or_init(start, end, num_classes,
+                                          layer_sizes, ckpt_path)
             params = jax.device_put(variables, device)
             _params_cache[key] = params
         return params
@@ -98,12 +92,8 @@ def _shared_preprocess(device):
         fn = _preprocess_cache.get(key)
         if fn is None:
             import jax
-            import jax.numpy as jnp
-
-            def preprocess(u8):
-                return u8.astype(jnp.bfloat16) * (2.0 / 255.0) - 1.0
-
-            fn = jax.jit(preprocess)
+            from rnb_tpu.models.r2p1d.network import normalize_u8
+            fn = jax.jit(normalize_u8)
             _preprocess_cache[key] = fn
         return fn
 

@@ -51,6 +51,13 @@ LAYER_INPUT_SHAPES = {
 LAYER_FEATURES = {2: 64, 3: 128, 4: 256, 5: 512}
 
 
+def normalize_u8(x, dtype=jnp.bfloat16):
+    """uint8 [0,255] frames -> ``dtype`` in [-1, 1] — the one
+    normalization every ingest path (pipeline loader preprocess,
+    sharded mesh step) must share."""
+    return x.astype(dtype) * (2.0 / 255.0) - 1.0
+
+
 def factored_channels(in_features: int, out_features: int,
                       t: int, d: int) -> int:
     """Intermediate width M_i of the (2+1)D factorization.

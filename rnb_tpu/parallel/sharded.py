@@ -31,7 +31,7 @@ import numpy as np
 from rnb_tpu.models.r2p1d import checkpoint as ckpt
 from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES, NUM_LAYERS,
                                           R18_LAYER_SIZES,
-                                          R2Plus1DClassifier)
+                                          R2Plus1DClassifier, normalize_u8)
 
 
 class ShardedInference:
@@ -43,8 +43,8 @@ class ShardedInference:
     aggregated logits ``(videos, num_classes)`` — already summed over
     each video's valid clips and psum-reduced across the ``sp`` axis.
 
-    The video axis must divide the mesh's ``dp`` size and the clip axis
-    its ``sp`` size (fixed shapes; pad with masked rows).
+    The mesh's ``dp`` size must divide the video axis and its ``sp``
+    size must divide ``max_clips`` (fixed shapes; pad with masked rows).
     """
 
     def __init__(self, mesh, max_clips: int = 15,
@@ -85,13 +85,8 @@ class ShardedInference:
                                    layer_sizes=layer_sizes, dtype=dtype)
 
         if variables is None:
-            if (num_classes, layer_sizes) == (KINETICS_CLASSES,
-                                              tuple(R18_LAYER_SIZES)):
-                variables = ckpt.load_for_range(1, NUM_LAYERS, ckpt_path)
-            else:
-                variables = ckpt.init_variables(
-                    start=1, end=NUM_LAYERS, num_classes=num_classes,
-                    layer_sizes=layer_sizes)
+            variables = ckpt.load_or_init(1, NUM_LAYERS, num_classes,
+                                          layer_sizes, ckpt_path)
         replicated = NamedSharding(mesh, P())
         self.variables = jax.device_put(variables, replicated)
 
@@ -106,8 +101,8 @@ class ShardedInference:
         def step(variables, vids, mask):
             # local shapes: vids (v, c, F, H, W, 3), mask (v, c)
             v, c = vids.shape[0], vids.shape[1]
-            x = vids.reshape((v * c,) + vids.shape[2:])
-            x = x.astype(dtype) * (2.0 / 255.0) - 1.0
+            x = normalize_u8(vids.reshape((v * c,) + vids.shape[2:]),
+                             dtype)
             logits = model.apply(variables, x, train=False)
             logits = logits.reshape(v, c, self.num_classes)
             per_video = (logits * mask[..., None]).sum(axis=1)

@@ -53,13 +53,14 @@ def test_build_mesh_and_submeshes():
 def _reference_logits(si, videos_u8, valid_clips):
     """Unsharded replay of the same math for comparison."""
     import jax.numpy as jnp
-    from rnb_tpu.models.r2p1d.network import R2Plus1DClassifier
+    from rnb_tpu.models.r2p1d.network import (R2Plus1DClassifier,
+                                              normalize_u8)
     model = R2Plus1DClassifier(num_classes=TINY["num_classes"],
                                layer_sizes=TINY["layer_sizes"],
                                dtype=jnp.bfloat16)
     v, c = videos_u8.shape[:2]
-    x = videos_u8.reshape((v * c,) + videos_u8.shape[2:])
-    x = jnp.asarray(x, jnp.bfloat16) * (2.0 / 255.0) - 1.0
+    x = normalize_u8(jnp.asarray(videos_u8.reshape(
+        (v * c,) + videos_u8.shape[2:])), jnp.bfloat16)
     logits = np.asarray(model.apply(si.variables, x, train=False))
     logits = logits.reshape(v, c, -1)
     mask = np.zeros((v, c), np.float32)
