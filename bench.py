@@ -38,8 +38,9 @@ def main() -> int:
     from rnb_tpu.benchmark import run_benchmark
 
     # everything the harness prints stays out of the one-line contract
+    captured_err = io.StringIO()
     with contextlib.redirect_stdout(io.StringIO()), \
-            contextlib.redirect_stderr(io.StringIO()):
+            contextlib.redirect_stderr(captured_err):
         result = run_benchmark(
             config_path=config,
             mean_interval_ms=mean_interval,
@@ -56,7 +57,12 @@ def main() -> int:
         "unit": "videos/s",
         "vs_baseline": round(value / BASELINE_VIDEOS_PER_SEC, 3),
     }))
-    return 0 if result.termination_flag == 0 else 1
+    if result.termination_flag != 0:
+        sys.stderr.write(captured_err.getvalue())
+        sys.stderr.write("bench: abnormal termination flag %d\n"
+                         % result.termination_flag)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

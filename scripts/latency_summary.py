@@ -1,0 +1,93 @@
+"""Latency decomposition report + stacked-bar chart over benchmark logs.
+
+Capability parity with the reference's ``scripts/latency_summary.py``
+(reference scripts/latency_summary.py:1-76): decompose end-to-end
+per-video latency into pipeline components (filename-queue wait, decode,
+frame-queue wait, device hand-off, neural net) and render one stacked
+bar per job, grouped by Poisson mean interval. Differences from the
+reference: parses the current log schema via ``parse_utils``, saves a
+PNG (headless Agg backend) instead of requiring TkAgg, and always prints
+a textual table so the numbers are usable without a display.
+
+Usage::
+
+    python scripts/latency_summary.py [--log-base logs] [--out latency.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from parse_utils import decompose_latency, get_data_from_all_logs  # noqa: E402
+
+
+def summarize(log_base: str):
+    """-> DataFrame: one row per job, mean ms per latency component."""
+    jobs, requests = get_data_from_all_logs(log_base)
+    if requests.empty:
+        return jobs, None
+    requests = decompose_latency(requests)
+    component_cols = [c for c in requests.columns
+                      if c.startswith("gap:") or c in (
+                          "filename_queue_wait", "runner0_dispatch",
+                          "decode", "frame_queue_wait", "device_comm",
+                          "neural_net")]
+    grouped = requests.groupby(
+        ["job_id", "mean_interval_ms"], as_index=False)[component_cols].mean()
+    return jobs, grouped
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-component latency summary over benchmark logs")
+    parser.add_argument("--log-base", default="logs")
+    parser.add_argument("--out", default=None,
+                        help="Optional PNG path for the stacked-bar chart")
+    args = parser.parse_args(argv)
+
+    jobs, grouped = summarize(args.log_base)
+    if grouped is None or grouped.empty:
+        print("No per-request timing tables found under %r" % args.log_base)
+        return 1
+
+    component_cols = [c for c in grouped.columns
+                      if c not in ("job_id", "mean_interval_ms")]
+    print(grouped.to_string(index=False,
+                            float_format=lambda v: "%.3f" % v))
+    print()
+    for _, row in grouped.iterrows():
+        total = sum(row[c] for c in component_cols)
+        print("%s: total %.3f ms end-to-end mean latency" % (row["job_id"],
+                                                             total))
+
+    if args.out:
+        import matplotlib
+        matplotlib.use("Agg")
+        from matplotlib import pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(max(6, 1.2 * len(grouped)), 5))
+        bottoms = [0.0] * len(grouped)
+        xs = range(len(grouped))
+        for col in component_cols:
+            vals = grouped[col].tolist()
+            ax.bar(xs, vals, bottom=bottoms, label=col)
+            bottoms = [b + v for b, v in zip(bottoms, vals)]
+        ax.set_xticks(list(xs))
+        ax.set_xticklabels(["%s\nmi=%s" % (j, mi) for j, mi in
+                            zip(grouped["job_id"], grouped["mean_interval_ms"])],
+                           rotation=30, ha="right", fontsize=8)
+        ax.set_ylabel("Mean latency (ms)")
+        ax.set_title("Per-video latency decomposition")
+        ax.legend(fontsize=8)
+        fig.tight_layout()
+        fig.savefig(args.out, dpi=120)
+        print("Wrote %s" % args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+"""Offline log parsing: benchmark log directories -> pandas DataFrames.
+
+Capability parity with the reference's ``scripts/parse_utils.py``
+(reference scripts/parse_utils.py:5-163) — but parsing the *current*
+log schema, fixing the staleness the reference shipped with (its parser
+expected an older arg set and the retired ``g%d-r%d.txt`` filename
+scheme; see SURVEY.md §2.1 #15):
+
+* ``logs/<job_id>/log-meta.txt`` — three lines written by
+  rnb_tpu/benchmark.py: an ``Args: Namespace(...)`` repr, start/end
+  wall-clock timestamps, and the termination flag.
+* ``logs/<job_id>/<device>-group<g>-<i>.txt`` — one whitespace table
+  per final-step instance (rnb_tpu/telemetry.py TimeCardSummary
+  .save_full_report): a header of event keys followed by per-step
+  device columns, then one row per completed request.
+
+Public API mirrors the reference: ``parse_meta``, ``get_data`` (one
+job), ``get_data_from_all_logs`` (every job under a log root, returning
+a job-level and a request-level DataFrame).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+#: ``Args: Namespace(mean_interval_ms=3, ..., config_file_path='x.json')``
+_ARGS_RE = re.compile(r"(\w+)=('[^']*'|\"[^\"]*\"|[^,)]+)")
+#: ``<device-label>-group<g>-<i>.txt`` (telemetry.logname)
+_TABLE_RE = re.compile(r"^(?P<device>.+)-group(?P<group>\d+)-"
+                       r"(?P<instance>\d+)\.txt$")
+
+
+def parse_meta(job_dir: str) -> Dict[str, object]:
+    """Parse one job's ``log-meta.txt`` into a flat dict.
+
+    Returns arg values (ints where possible), ``time_start``/``time_end``,
+    ``wall_time_s``, ``termination_flag``, and ``throughput_vps`` derived
+    from the job's video count and wall time.
+    """
+    meta: Dict[str, object] = {"job_id": os.path.basename(job_dir.rstrip("/"))}
+    with open(os.path.join(job_dir, "log-meta.txt")) as f:
+        lines = f.read().splitlines()
+    for line in lines:
+        if line.startswith("Args:"):
+            for key, raw in _ARGS_RE.findall(line):
+                raw = raw.strip()
+                if raw[:1] in "'\"":
+                    meta[key] = raw[1:-1]
+                else:
+                    try:
+                        meta[key] = int(raw)
+                    except ValueError:
+                        try:
+                            meta[key] = float(raw)
+                        except ValueError:
+                            meta[key] = raw
+        elif line.startswith("Termination flag:"):
+            meta["termination_flag"] = int(line.split(":")[1])
+        else:
+            parts = line.split()
+            if len(parts) == 2:
+                meta["time_start"], meta["time_end"] = map(float, parts)
+    if "time_start" in meta and "time_end" in meta:
+        meta["wall_time_s"] = meta["time_end"] - meta["time_start"]
+        videos = meta.get("videos")
+        if videos and meta["wall_time_s"] > 0:
+            meta["throughput_vps"] = videos / meta["wall_time_s"]
+    return meta
+
+
+def parse_timing_table(path: str) -> pd.DataFrame:
+    """Parse one final-instance timing table.
+
+    Timestamp columns stay float; ``device*`` columns stay string. The
+    producing replica's identity (from the filename) is attached as
+    ``final_device`` / ``final_group`` / ``final_instance`` columns.
+    """
+    with open(path) as f:
+        header = f.readline().split()
+        rows = [line.split() for line in f if line.strip()]
+    df = pd.DataFrame(rows, columns=header)
+    for col in df.columns:
+        if not col.startswith("device"):
+            df[col] = df[col].astype(float)
+    m = _TABLE_RE.match(os.path.basename(path))
+    if m:
+        df["final_device"] = m.group("device")
+        df["final_group"] = int(m.group("group"))
+        df["final_instance"] = int(m.group("instance"))
+    return df
+
+
+def _timing_tables(job_dir: str) -> List[str]:
+    return sorted(
+        os.path.join(job_dir, name) for name in os.listdir(job_dir)
+        if _TABLE_RE.match(name))
+
+
+def get_data(job_dir: str) -> Tuple[Dict[str, object], pd.DataFrame]:
+    """One job -> (meta dict, request-level DataFrame).
+
+    The request DataFrame concatenates every final instance's table and
+    carries the job's meta columns so per-request rows are self-describing
+    (reference get_data, scripts/parse_utils.py:32-69).
+    """
+    meta = parse_meta(job_dir)
+    tables = [parse_timing_table(p) for p in _timing_tables(job_dir)]
+    if tables:
+        df = pd.concat(tables, ignore_index=True)
+    else:
+        df = pd.DataFrame()
+    for key in ("job_id", "mean_interval_ms", "batch_size", "videos",
+                "queue_size"):
+        if key in meta:
+            df[key] = meta[key]
+    return meta, df
+
+
+def get_data_from_all_logs(log_base: str = "logs") \
+        -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """Every job under ``log_base`` -> (jobs DataFrame, requests DataFrame).
+
+    Mirrors the reference's two-frame contract
+    (scripts/parse_utils.py:72-163): the first frame has one row per job
+    (args + wall time + throughput), the second one row per request.
+    Jobs whose meta file is missing or unparsable are skipped.
+    """
+    metas: List[Dict[str, object]] = []
+    request_frames: List[pd.DataFrame] = []
+    for name in sorted(os.listdir(log_base)):
+        job_dir = os.path.join(log_base, name)
+        if not os.path.isfile(os.path.join(job_dir, "log-meta.txt")):
+            continue
+        try:
+            meta, df = get_data(job_dir)
+        except (OSError, ValueError):
+            continue
+        metas.append(meta)
+        if not df.empty:
+            request_frames.append(df)
+    jobs = pd.DataFrame(metas)
+    requests = (pd.concat(request_frames, ignore_index=True)
+                if request_frames else pd.DataFrame())
+    return jobs, requests
+
+
+#: Semantic names for the standard 2-stage (decode -> network) schema's
+#: inter-event gaps — the decomposition the reference plots
+#: (scripts/latency_summary.py:29-33).
+STANDARD_COMPONENTS = [
+    ("enqueue_filename", "runner0_start", "filename_queue_wait"),
+    ("runner0_start", "inference0_start", "runner0_dispatch"),
+    ("inference0_start", "inference0_finish", "decode"),
+    ("inference0_finish", "runner1_start", "frame_queue_wait"),
+    ("runner1_start", "inference1_start", "device_comm"),
+    ("inference1_start", "inference1_finish", "neural_net"),
+]
+
+
+def decompose_latency(df: pd.DataFrame) -> pd.DataFrame:
+    """Add per-request latency-component columns (milliseconds).
+
+    Standard-schema gaps get their semantic names; any remaining adjacent
+    event pairs get ``gap:<prev>-><next>`` columns so segmented/merged
+    schemas still decompose fully.
+    """
+    time_cols = [c for c in df.columns
+                 if df[c].dtype == float and not c.startswith("device")
+                 and c not in ("final_group", "final_instance")]
+    named = set()
+    out = df.copy()
+    for prv, nxt, name in STANDARD_COMPONENTS:
+        if prv in time_cols and nxt in time_cols:
+            out[name] = (df[nxt] - df[prv]) * 1000.0
+            named.update((prv, nxt))
+    for prv, nxt in zip(time_cols[:-1], time_cols[1:]):
+        if prv in named and nxt in named:
+            continue
+        out["gap:%s->%s" % (prv, nxt)] = (df[nxt] - df[prv]) * 1000.0
+    return out

@@ -1,0 +1,103 @@
+"""Offline log-analysis scripts parse what the runtime actually writes.
+
+The reference shipped a parser stale against its own log schema
+(SURVEY.md §2.1 #15); these tests pin ours to the real writers by
+round-tripping through TimeCardSummary.save_full_report and the
+log-meta format emitted by rnb_tpu/benchmark.py.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from parse_utils import (decompose_latency, get_data,  # noqa: E402
+                         get_data_from_all_logs, parse_meta,
+                         parse_timing_table)
+from rnb_tpu.telemetry import TimeCard, TimeCardSummary, logname  # noqa: E402
+
+
+def _make_job(log_base, job_id, num_requests=5, mi=90):
+    """Write a job dir through the real telemetry writers."""
+    keys = ["enqueue_filename", "runner0_start", "inference0_start",
+            "inference0_finish", "runner1_start", "inference1_start",
+            "inference1_finish"]
+    summary = TimeCardSummary()
+    t = 1000.0
+    for req in range(num_requests):
+        tc = TimeCard(req)
+        for k_idx, key in enumerate(keys):
+            tc.timings[key] = t + req * 10.0 + k_idx * 0.5
+        tc.add_device("tpu0")
+        tc.add_device("tpu1")
+        summary.register(tc)
+    path = logname(job_id, "tpu1", 0, 0, base=log_base)
+    with open(path, "w") as f:
+        summary.save_full_report(f)
+    with open(os.path.join(log_base, job_id, "log-meta.txt"), "w") as f:
+        f.write("Args: Namespace(mean_interval_ms=%d, batch_size=1, "
+                "videos=%d, queue_size=500, "
+                "config_file_path='configs/r2p1d-whole.json')\n"
+                % (mi, num_requests))
+        f.write("%f %f\n" % (t, t + 50.0))
+        f.write("Termination flag: 0\n")
+    return path
+
+
+def test_parse_meta_roundtrip(tmp_path):
+    _make_job(str(tmp_path), "job-a", num_requests=5, mi=90)
+    meta = parse_meta(str(tmp_path / "job-a"))
+    assert meta["mean_interval_ms"] == 90
+    assert meta["videos"] == 5
+    assert meta["config_file_path"] == "configs/r2p1d-whole.json"
+    assert meta["termination_flag"] == 0
+    assert meta["wall_time_s"] == pytest.approx(50.0)
+    assert meta["throughput_vps"] == pytest.approx(0.1)
+
+
+def test_parse_timing_table_types_and_identity(tmp_path):
+    path = _make_job(str(tmp_path), "job-a")
+    df = parse_timing_table(path)
+    assert len(df) == 5
+    assert df["enqueue_filename"].dtype == float
+    assert df["device0"].iloc[0] == "tpu0"
+    assert df["final_device"].iloc[0] == "tpu1"
+    assert df["final_group"].iloc[0] == 0
+    assert df["final_instance"].iloc[0] == 0
+
+
+def test_get_data_from_all_logs_two_jobs(tmp_path):
+    _make_job(str(tmp_path), "job-a", num_requests=5, mi=90)
+    _make_job(str(tmp_path), "job-b", num_requests=3, mi=0)
+    jobs, requests = get_data_from_all_logs(str(tmp_path))
+    assert set(jobs["job_id"]) == {"job-a", "job-b"}
+    assert len(requests) == 8
+    assert set(requests["mean_interval_ms"]) == {90, 0}
+
+
+def test_decompose_latency_standard_schema(tmp_path):
+    path = _make_job(str(tmp_path), "job-a")
+    df = decompose_latency(parse_timing_table(path))
+    # every adjacent gap in the synthetic cards is exactly 0.5 s = 500 ms
+    for col in ("filename_queue_wait", "decode", "frame_queue_wait",
+                "device_comm", "neural_net"):
+        assert df[col].iloc[0] == pytest.approx(500.0), col
+
+
+def test_latency_summary_cli(tmp_path, capsys):
+    _make_job(str(tmp_path), "job-a")
+    import latency_summary
+    out_png = str(tmp_path / "latency.png")
+    rc = latency_summary.main(["--log-base", str(tmp_path),
+                               "--out", out_png])
+    assert rc == 0
+    assert os.path.exists(out_png)
+    captured = capsys.readouterr()
+    assert "job-a" in captured.out
+
+
+def test_latency_summary_cli_empty(tmp_path):
+    import latency_summary
+    assert latency_summary.main(["--log-base", str(tmp_path)]) == 1
