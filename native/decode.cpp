@@ -1,0 +1,336 @@
+// Native host-side video decoder: the TPU-native stand-in for the
+// reference's NVVL fork (SURVEY.md §2.2 N2; reference usage at
+// models/r2p1d/model.py:123-145).  TPUs have no video ASIC, so decode
+// is host CPU work; this library makes it native C++ with a worker
+// pool so the decode stage keeps up with the accelerator.
+//
+// Format: uncompressed YUV4MPEG2 (.y4m), 4:2:0 or 4:4:4 — the format
+// the pure-numpy Y4MDecoder (rnb_tpu/decode/__init__.py) also speaks;
+// the two backends are numerically parity-tested against each other.
+//
+// Design notes:
+//  * The decode of one output pixel needs exactly one Y/U/V sample
+//    (nearest-neighbour chroma upsample + box-resize are both pure
+//    index maps), so decode, upsample, convert and resize are fused
+//    into a single gather per output pixel — unlike the numpy path,
+//    the full frame is never materialized.
+//  * C ABI only (consumed via ctypes; pybind11 is not available in
+//    this image).  All buffers are caller-owned.
+//  * The pool is a plain mutex+condvar job queue; one ticket per
+//    submitted decode, waitable from any thread.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kErrIo = -1;        // open/seek/read failure
+constexpr int kErrFormat = -2;    // not a y4m / bad header / bad marker
+constexpr int kErrColorspace = -3;
+constexpr int kErrArg = -4;
+
+struct Y4mMeta {
+  int width = 0;
+  int height = 0;
+  int subsample = 1;           // 1 = 4:4:4, 2 = 4:2:0
+  long long frame_bytes = 0;
+  long long data_start = 0;    // offset of first FRAME marker
+  long long marker_len = 0;    // length of b"FRAME...\n" incl newline
+  long long stride = 0;        // marker + payload
+  long long count = 0;         // number of frames
+};
+
+// Read one '\n'-terminated line starting at `off`.  Returns false on
+// IO error or if no newline is found within `maxlen` bytes.
+bool ReadLine(FILE* f, long long off, std::string* line,
+              size_t maxlen = 65536) {
+  if (fseeko(f, off, SEEK_SET) != 0) return false;
+  line->clear();
+  int c;
+  while (line->size() < maxlen && (c = fgetc(f)) != EOF) {
+    line->push_back(static_cast<char>(c));
+    if (c == '\n') return true;
+  }
+  return false;
+}
+
+int ProbeFile(const char* path, Y4mMeta* meta) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrIo;
+  std::string header;
+  if (!ReadLine(f, 0, &header) || header.rfind("YUV4MPEG2", 0) != 0) {
+    fclose(f);
+    return kErrFormat;
+  }
+  meta->width = meta->height = 0;
+  std::string cs = "420";
+  // tokens after the magic, space-separated, tag = first char
+  size_t pos = header.find(' ');
+  while (pos != std::string::npos && pos + 1 < header.size()) {
+    size_t end = header.find_first_of(" \n", pos + 1);
+    std::string token = header.substr(pos + 1, end - pos - 1);
+    if (!token.empty()) {
+      char tag = token[0];
+      std::string val = token.substr(1);
+      if (tag == 'W') meta->width = atoi(val.c_str());
+      else if (tag == 'H') meta->height = atoi(val.c_str());
+      else if (tag == 'C') cs = val;
+    }
+    pos = (end == std::string::npos || header[end] == '\n')
+              ? std::string::npos : end;
+  }
+  if (meta->width <= 0 || meta->height <= 0) {
+    fclose(f);
+    return kErrFormat;
+  }
+  const long long wh =
+      static_cast<long long>(meta->width) * meta->height;
+  if (cs.rfind("420", 0) == 0) {
+    meta->subsample = 2;
+    meta->frame_bytes = wh * 3 / 2;
+  } else if (cs.rfind("444", 0) == 0) {
+    meta->subsample = 1;
+    meta->frame_bytes = wh * 3;
+  } else {
+    fclose(f);
+    return kErrColorspace;
+  }
+  meta->data_start = static_cast<long long>(header.size());
+  std::string marker;
+  if (!ReadLine(f, meta->data_start, &marker) ||
+      marker.rfind("FRAME", 0) != 0) {
+    fclose(f);
+    return kErrFormat;
+  }
+  meta->marker_len = static_cast<long long>(marker.size());
+  meta->stride = meta->marker_len + meta->frame_bytes;
+  if (fseeko(f, 0, SEEK_END) != 0) {
+    fclose(f);
+    return kErrIo;
+  }
+  const long long size = ftello(f);
+  fclose(f);
+  meta->count = (size - meta->data_start) / meta->stride;
+  if (meta->count <= 0) return kErrFormat;
+  return 0;
+}
+
+inline unsigned char ClipByte(float v) {
+  if (v < 0.f) v = 0.f;
+  if (v > 255.f) v = 255.f;
+  return static_cast<unsigned char>(v);  // trunc, matches np.astype(u8)
+}
+
+// Convert one source frame payload into the caller's RGB output tile,
+// fusing nearest chroma upsample + box resize (out[r][c] samples
+// source pixel (r*h/out_h, c*w/out_w) — the numpy backend's index map).
+void ConvertFrame(const unsigned char* payload, const Y4mMeta& m,
+                  int out_w, int out_h, unsigned char* out) {
+  const int w = m.width, h = m.height, sub = m.subsample;
+  const int cw = w / sub;
+  const unsigned char* yp = payload;
+  const unsigned char* up = payload + static_cast<long long>(w) * h;
+  const unsigned char* vp = up + static_cast<long long>(cw) * (h / sub);
+  for (int r = 0; r < out_h; ++r) {
+    const int sy = static_cast<int>(
+        static_cast<long long>(r) * h / out_h);
+    const unsigned char* yrow = yp + static_cast<long long>(sy) * w;
+    const unsigned char* urow = up + static_cast<long long>(sy / sub) * cw;
+    const unsigned char* vrow = vp + static_cast<long long>(sy / sub) * cw;
+    unsigned char* orow = out + static_cast<long long>(r) * out_w * 3;
+    for (int c = 0; c < out_w; ++c) {
+      const int sx = static_cast<int>(
+          static_cast<long long>(c) * w / out_w);
+      const float yf = static_cast<float>(yrow[sx]);
+      const float uf = static_cast<float>(urow[sx / sub]) - 128.0f;
+      const float vf = static_cast<float>(vrow[sx / sub]) - 128.0f;
+      orow[c * 3 + 0] = ClipByte(yf + 1.402f * vf);
+      orow[c * 3 + 1] = ClipByte(yf - 0.344136f * uf - 0.714136f * vf);
+      orow[c * 3 + 2] = ClipByte(yf + 1.772f * uf);
+    }
+  }
+}
+
+int DecodeClips(const char* path, const long long* clip_starts,
+                int num_clips, int consecutive, int out_w, int out_h,
+                unsigned char* out) {
+  if (num_clips < 0 || consecutive <= 0 || out_w <= 0 || out_h <= 0 ||
+      out == nullptr)
+    return kErrArg;
+  Y4mMeta m;
+  int rc = ProbeFile(path, &m);
+  if (rc != 0) return rc;
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrIo;
+  std::vector<unsigned char> payload(
+      static_cast<size_t>(m.frame_bytes));
+  const long long frame_out =
+      static_cast<long long>(out_h) * out_w * 3;
+  long long last_idx = -1;
+  for (int ci = 0; ci < num_clips; ++ci) {
+    if (clip_starts[ci] < 0) {
+      fclose(f);
+      return kErrArg;  // numpy backend rejects these too
+    }
+    for (int fi = 0; fi < consecutive; ++fi) {
+      long long idx = clip_starts[ci] + fi;
+      if (idx > m.count - 1) idx = m.count - 1;  // clamp like numpy
+      unsigned char* dst =
+          out + (static_cast<long long>(ci) * consecutive + fi) * frame_out;
+      if (idx != last_idx) {
+        if (fseeko(f, m.data_start + idx * m.stride + m.marker_len,
+                   SEEK_SET) != 0 ||
+            fread(payload.data(), 1, payload.size(), f) !=
+                payload.size()) {
+          fclose(f);
+          return kErrIo;
+        }
+        last_idx = idx;
+        ConvertFrame(payload.data(), m, out_w, out_h, dst);
+      } else {
+        // consecutive repeats of the clamped last frame: copy the
+        // previous converted output instead of re-decoding
+        std::memcpy(dst, dst - frame_out, frame_out);
+      }
+    }
+  }
+  fclose(f);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Worker pool: submit() -> ticket, wait(ticket) -> rc.
+
+struct Job {
+  long long ticket;
+  std::string path;
+  std::vector<long long> starts;
+  int consecutive, out_w, out_h;
+  unsigned char* out;
+};
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::deque<Job> jobs;
+  std::map<long long, int> done;  // ticket -> rc
+  std::mutex mu;
+  std::condition_variable cv_job, cv_done;
+  long long next_ticket = 1;
+  bool stopping = false;
+
+  explicit Pool(int n) {
+    for (int i = 0; i < n; ++i)
+      workers.emplace_back([this] { Run(); });
+  }
+
+  void Run() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_job.wait(lk, [this] { return stopping || !jobs.empty(); });
+        if (jobs.empty()) return;  // stopping
+        job = std::move(jobs.front());
+        jobs.pop_front();
+      }
+      const int rc = DecodeClips(
+          job.path.c_str(), job.starts.data(),
+          static_cast<int>(job.starts.size()), job.consecutive,
+          job.out_w, job.out_h, job.out);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        done[job.ticket] = rc;
+      }
+      cv_done.notify_all();
+    }
+  }
+
+  long long Submit(Job job) {
+    long long t;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      t = next_ticket++;
+      job.ticket = t;
+      jobs.push_back(std::move(job));
+    }
+    cv_job.notify_one();
+    return t;
+  }
+
+  int Wait(long long ticket) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [&] { return done.count(ticket) > 0; });
+    const int rc = done[ticket];
+    done.erase(ticket);
+    return rc;
+  }
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_job.notify_all();
+    for (auto& w : workers) w.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int rnb_y4m_probe(const char* path, int* width, int* height,
+                  long long* num_frames) {
+  Y4mMeta m;
+  const int rc = ProbeFile(path, &m);
+  if (rc != 0) return rc;
+  if (width) *width = m.width;
+  if (height) *height = m.height;
+  if (num_frames) *num_frames = m.count;
+  return 0;
+}
+
+int rnb_y4m_decode_clips(const char* path, const long long* clip_starts,
+                         int num_clips, int consecutive, int out_w,
+                         int out_h, unsigned char* out) {
+  return DecodeClips(path, clip_starts, num_clips, consecutive, out_w,
+                     out_h, out);
+}
+
+void* rnb_pool_create(int num_threads) {
+  if (num_threads <= 0) num_threads = 1;
+  return new Pool(num_threads);
+}
+
+void rnb_pool_destroy(void* pool) { delete static_cast<Pool*>(pool); }
+
+long long rnb_pool_submit(void* pool, const char* path,
+                          const long long* clip_starts, int num_clips,
+                          int consecutive, int out_w, int out_h,
+                          unsigned char* out) {
+  if (!pool || num_clips < 0) return -1;
+  Job job;
+  job.path = path;
+  job.starts.assign(clip_starts, clip_starts + num_clips);
+  job.consecutive = consecutive;
+  job.out_w = out_w;
+  job.out_h = out_h;
+  job.out = out;
+  return static_cast<Pool*>(pool)->Submit(std::move(job));
+}
+
+int rnb_pool_wait(void* pool, long long ticket) {
+  if (!pool || ticket <= 0) return kErrArg;
+  return static_cast<Pool*>(pool)->Wait(ticket);
+}
+
+}  // extern "C"

@@ -168,6 +168,8 @@ class Y4MDecoder(VideoDecoder):
     def decode_clips(self, video, clip_starts, consecutive_frames=8,
                      width=DEFAULT_WIDTH, height=DEFAULT_HEIGHT):
         meta = self._parse_header(video)
+        if any(s < 0 for s in clip_starts):
+            raise ValueError("negative clip start in %r" % (clip_starts,))
         out = np.empty((len(clip_starts), consecutive_frames, height, width,
                         3), dtype=np.uint8)
         with open(video, "rb") as f:
@@ -199,10 +201,18 @@ def write_y4m(path: str, frames: np.ndarray) -> None:
 
 
 def get_decoder(video: str) -> VideoDecoder:
-    """Pick a backend for one video path/id."""
+    """Pick a backend for one video path/id.
+
+    .y4m files prefer the native C++ worker-pool decoder when built
+    (``make -C native``; disable with RNB_DISABLE_NATIVE=1), falling
+    back to the numpy backend with identical numerics.
+    """
     if video.startswith(SYNTH_PREFIX) or not os.path.exists(video):
         return SyntheticDecoder()
     if video.endswith(".y4m"):
+        from rnb_tpu.decode.native import NativeY4MDecoder, native_available
+        if native_available():
+            return NativeY4MDecoder()
         return Y4MDecoder()
     raise ValueError(
         "no decode backend for %r: only synth:// ids and .y4m files are "

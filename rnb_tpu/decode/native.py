@@ -1,0 +1,187 @@
+"""ctypes bindings for the native C++ decoder (native/decode.cpp).
+
+The native library is the performance path for .y4m decode — a fused
+probe/decode/convert/resize in C++ with an internal worker pool, the
+TPU-native replacement for the role NVVL's GPU decoder played in the
+reference (SURVEY.md §2.2 N2).  Everything degrades gracefully: if the
+shared library has not been built (``make -C native``) the pure-numpy
+:class:`~rnb_tpu.decode.Y4MDecoder` carries the same contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from rnb_tpu.decode import (DEFAULT_HEIGHT, DEFAULT_WIDTH, VideoDecoder)
+
+_ERR_MSGS = {
+    -1: "I/O error",
+    -2: "not a y4m file / malformed header",
+    -3: "unsupported colourspace",
+    -4: "bad argument",
+}
+
+_lib = None
+_lib_checked = False
+_lib_lock = threading.Lock()
+
+
+def _lib_path() -> str:
+    override = os.environ.get("RNB_NATIVE_LIB")
+    if override:
+        return override
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo_root, "native", "build", "librnb_decode.so")
+
+
+def load_native():
+    """-> the loaded ctypes library, or None if unavailable/disabled."""
+    global _lib, _lib_checked
+    if os.environ.get("RNB_DISABLE_NATIVE"):
+        return None
+    with _lib_lock:
+        if _lib_checked:
+            return _lib
+        _lib_checked = True
+        path = _lib_path()
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.rnb_y4m_probe.restype = ctypes.c_int
+        lib.rnb_y4m_probe.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_longlong)]
+        lib.rnb_y4m_decode_clips.restype = ctypes.c_int
+        lib.rnb_y4m_decode_clips.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p]
+        lib.rnb_pool_create.restype = ctypes.c_void_p
+        lib.rnb_pool_create.argtypes = [ctypes.c_int]
+        lib.rnb_pool_destroy.restype = None
+        lib.rnb_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.rnb_pool_submit.restype = ctypes.c_longlong
+        lib.rnb_pool_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+        lib.rnb_pool_wait.restype = ctypes.c_int
+        lib.rnb_pool_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def _check(rc: int, path: str) -> None:
+    if rc != 0:
+        raise ValueError("native y4m decode of %r failed: %s"
+                         % (path, _ERR_MSGS.get(rc, "error %d" % rc)))
+
+
+class DecodePool:
+    """Worker pool over the native library; submit/wait across videos.
+
+    One pool is shared per process (``DecodePool.shared()``); the
+    loader stage uses it to overlap decode of queued videos the way the
+    reference's NVVL loader overlapped NVDEC work with inference
+    (reference README.md:46-110).
+    """
+
+    def __init__(self, num_threads: Optional[int] = None):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native decode library not built; run "
+                               "`make -C native`")
+        if num_threads is None:
+            num_threads = int(os.environ.get("RNB_DECODE_THREADS",
+                                             min(8, os.cpu_count() or 1)))
+        self._lib = lib
+        self._pool = lib.rnb_pool_create(int(num_threads))
+        self.num_threads = int(num_threads)
+        # ticket -> (out, starts): keeps the buffers a worker thread
+        # writes into alive until wait() retires the job, even if the
+        # caller drops its references mid-flight
+        self._pending = {}
+        self._pending_lock = threading.Lock()
+
+    _shared = None
+    _shared_lock = threading.Lock()
+
+    @classmethod
+    def shared(cls) -> "DecodePool":
+        with cls._shared_lock:
+            if cls._shared is None:
+                cls._shared = cls()
+            return cls._shared
+
+    def submit(self, path: str, clip_starts: List[int],
+               consecutive_frames: int, width: int, height: int):
+        """-> (ticket, out_array); pass ticket to :meth:`wait`."""
+        out = np.empty((len(clip_starts), consecutive_frames, height,
+                        width, 3), dtype=np.uint8)
+        starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
+        ticket = self._lib.rnb_pool_submit(
+            self._pool, path.encode(), starts, len(clip_starts),
+            consecutive_frames, width, height,
+            out.ctypes.data_as(ctypes.c_char_p))
+        if ticket <= 0:
+            raise RuntimeError("native pool rejected submit for %r" % path)
+        with self._pending_lock:
+            self._pending[ticket] = (out, starts)
+        return ticket, out
+
+    def wait(self, ticket: int, path: str = "<submitted>") -> None:
+        try:
+            _check(self._lib.rnb_pool_wait(self._pool, ticket), path)
+        finally:
+            with self._pending_lock:
+                self._pending.pop(ticket, None)
+
+    def close(self) -> None:
+        if self._pool:
+            self._lib.rnb_pool_destroy(self._pool)
+            self._pool = None
+
+
+class NativeY4MDecoder(VideoDecoder):
+    """VideoDecoder backed by the C++ library (sync calls)."""
+
+    def __init__(self):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native decode library not built; run "
+                               "`make -C native`")
+        self._lib = lib
+        self._count_cache = {}
+
+    def num_frames(self, video: str) -> int:
+        if video not in self._count_cache:
+            n = ctypes.c_longlong()
+            _check(self._lib.rnb_y4m_probe(video.encode(), None, None,
+                                           ctypes.byref(n)), video)
+            self._count_cache[video] = int(n.value)
+        return self._count_cache[video]
+
+    def decode_clips(self, video: str, clip_starts: List[int],
+                     consecutive_frames: int = 8,
+                     width: int = DEFAULT_WIDTH,
+                     height: int = DEFAULT_HEIGHT) -> np.ndarray:
+        out = np.empty((len(clip_starts), consecutive_frames, height,
+                        width, 3), dtype=np.uint8)
+        starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
+        _check(self._lib.rnb_y4m_decode_clips(
+            video.encode(), starts, len(clip_starts), consecutive_frames,
+            width, height, out.ctypes.data_as(ctypes.c_char_p)), video)
+        return out

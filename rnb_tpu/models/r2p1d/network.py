@@ -54,8 +54,10 @@ LAYER_FEATURES = {2: 64, 3: 128, 4: 256, 5: 512}
 def normalize_u8(x, dtype=jnp.bfloat16):
     """uint8 [0,255] frames -> ``dtype`` in [-1, 1] — the one
     normalization every ingest path (pipeline loader preprocess,
-    sharded mesh step) must share."""
-    return x.astype(dtype) * (2.0 / 255.0) - 1.0
+    sharded mesh step) must share. Pallas kernel on TPU, jnp
+    elsewhere (rnb_tpu.ops.preprocess)."""
+    from rnb_tpu.ops.preprocess import normalize_u8 as _impl
+    return _impl(x, dtype=dtype)
 
 
 def factored_channels(in_features: int, out_features: int,

@@ -1,0 +1,17 @@
+"""Custom TPU ops (Pallas kernels) with portable jnp fallbacks.
+
+The compute path of this framework is almost entirely XLA-compiled
+Flax/jnp code — XLA already fuses elementwise work into the conv/matmul
+HLOs that dominate R(2+1)D. The ops package holds the few hand-written
+Pallas kernels for boundaries XLA cannot see across, currently the
+host->device ingest preprocess (uint8 decode output -> normalized
+bfloat16 activations) that every video batch crosses exactly once
+(reference analog: the uint8->float cast + permute after NVVL decode,
+reference models/r2p1d/model.py:149-151).
+
+Every op exposes one public entry point that dispatches to the Pallas
+kernel on TPU backends and to an identical jnp formulation elsewhere
+(CPU tests, interpret mode), so numerics are defined once.
+"""
+
+from rnb_tpu.ops.preprocess import normalize_u8  # noqa: F401

@@ -1,0 +1,90 @@
+"""Ingest preprocess kernel: uint8 frames -> normalized bfloat16.
+
+This is the one op every video batch crosses on its way from the host
+decoder into the network (the TPU-native analog of the reference's
+post-NVVL ``.float()`` cast, reference models/r2p1d/model.py:149-151):
+
+    y = x.astype(bf16) * (2/255) - 1        # [0,255] -> [-1,1]
+
+XLA would fuse this into the consuming conv when it can; the Pallas
+kernel makes the ingest cost explicit and keeps the uint8->bf16
+widening on the VPU with lane-aligned tiles, independent of what the
+consumer looks like (it may live behind a ``device_put`` boundary in
+the pipelined runtime, where there is no consumer to fuse into).
+
+Layout strategy: the logical clip shape ``(N, F, H, W, 3)`` is
+irrelevant to an elementwise op, so the wrapper flattens to
+``(M, 128)`` lanes and grids over row blocks; Pallas masks the ragged
+final block. Inputs whose element count is not lane-divisible (never
+the case for the 112x112x3 production geometry) take the jnp path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+#: uint8 min sublane tile is 32; use a healthy multiple for fewer grid
+#: steps while staying far under VMEM (2 x 512 x 128 x ~3B per step).
+BLOCK_ROWS = 512
+
+
+def normalize_u8_reference(x, dtype=jnp.bfloat16):
+    """The jnp formulation (also the numerics contract for the kernel).
+
+    Written as ``(2x - 255) * (1/255)``: the inner term is exact
+    integer arithmetic in f32 (|2x-255| <= 255), leaving a single
+    rounding multiply — no mul+add pair a compiler could contract into
+    an FMA — so every backend (XLA CPU/TPU, Mosaic, interpret mode)
+    produces bit-identical f32, rounded to ``dtype`` exactly once.
+    """
+    xf = x.astype(jnp.float32)
+    return ((xf * 2.0 - 255.0) * jnp.float32(1.0 / 255.0)).astype(dtype)
+
+
+def _normalize_kernel(x_ref, o_ref):
+    # Mosaic has no direct uint8->bf16 cast; widen via int32/f32 on the
+    # VPU. Same FMA-proof formulation as normalize_u8_reference.
+    x = x_ref[:].astype(jnp.int32).astype(jnp.float32)
+    o_ref[:] = ((x * 2.0 - 255.0)
+                * jnp.float32(1.0 / 255.0)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _normalize_u8_pallas(x, dtype=jnp.bfloat16):
+    from jax.experimental import pallas as pl
+
+    flat = x.reshape(-1, LANES)
+    rows = flat.shape[0]
+    block = min(BLOCK_ROWS, rows)
+    out = pl.pallas_call(
+        _normalize_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), dtype),
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[pl.BlockSpec((block, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+    )(flat)
+    return out.reshape(x.shape)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def normalize_u8(x, dtype=jnp.bfloat16):
+    """uint8 [0,255] frames -> ``dtype`` in [-1, 1].
+
+    The single normalization every ingest path shares (pipeline loader
+    preprocess, sharded mesh step). Dispatches to the Pallas kernel on
+    TPU when the element count is lane-divisible, else to jnp.
+    """
+    if x.dtype == jnp.uint8 and x.size > 0 and x.size % LANES == 0 \
+            and _on_tpu():
+        return _normalize_u8_pallas(x, dtype=dtype)
+    return normalize_u8_reference(x, dtype=dtype)

@@ -1,0 +1,94 @@
+"""Pallas ingest-preprocess kernel: numerics parity with the jnp path.
+
+The CPU test backend cannot run compiled TPU kernels, so the kernel
+body itself is exercised through the Pallas interpreter and must match
+``normalize_u8_reference`` bit-for-bit; the dispatching wrapper is
+checked to fall back cleanly off-TPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+
+from rnb_tpu.ops import normalize_u8
+from rnb_tpu.ops.preprocess import (LANES, _normalize_kernel,
+                                    normalize_u8_reference)
+
+
+def _run_interpret(x, dtype, block_rows):
+    flat = x.reshape(-1, LANES)
+    rows = flat.shape[0]
+    out = pl.pallas_call(
+        _normalize_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), dtype),
+        grid=(pl.cdiv(rows, block_rows),),
+        in_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+        interpret=True,
+    )(flat)
+    return out.reshape(x.shape)
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 16, 16, 3), (15, 8, 112, 8, 2)])
+def test_kernel_matches_reference(shape):
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, shape, dtype=np.uint8)
+    got = _run_interpret(jnp.asarray(x), jnp.float32, block_rows=8)
+    want = normalize_u8_reference(jnp.asarray(x), jnp.float32)
+    # FMA contraction inside the kernel may differ by 1 ulp
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0, atol=2e-7)
+
+
+def test_kernel_ragged_final_block():
+    # rows not divisible by the block: Pallas masks the tail block
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 256, (40, LANES), dtype=np.uint8)  # 40 = 8*5
+    got = _run_interpret(jnp.asarray(x), jnp.float32, block_rows=16)
+    want = normalize_u8_reference(jnp.asarray(x), jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=0, atol=2e-7)
+
+
+def test_range_endpoints():
+    x = jnp.asarray([[0] * LANES, [255] * LANES], dtype=jnp.uint8)
+    y = np.asarray(_run_interpret(x, jnp.float32, block_rows=8))
+    assert y.min() == pytest.approx(-1.0)
+    assert y.max() == pytest.approx(1.0)
+
+
+def test_kernel_matches_reference_bf16():
+    # parity at the PRODUCTION dtype: both paths must round to bf16
+    # exactly once, from the same f32 intermediate
+    x = jnp.arange(256, dtype=jnp.uint8).reshape(2, LANES)
+    got = _run_interpret(x, jnp.bfloat16, block_rows=8)
+    want = normalize_u8_reference(x, jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+
+def test_empty_input_dispatch():
+    x = jnp.zeros((0, 8, LANES), dtype=jnp.uint8)
+    y = normalize_u8(x)
+    assert y.shape == (0, 8, LANES) and y.dtype == jnp.bfloat16
+
+
+def test_dispatch_off_tpu_falls_back():
+    # On the CPU test backend the wrapper must take the jnp path and
+    # still produce the contract numerics in bf16.
+    x = np.full((4, LANES), 128, dtype=np.uint8)
+    y = normalize_u8(jnp.asarray(x))
+    assert y.dtype == jnp.bfloat16
+    want = normalize_u8_reference(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(want, np.float32))
+
+
+def test_network_normalize_delegates():
+    from rnb_tpu.models.r2p1d.network import normalize_u8 as net_norm
+    x = np.full((2, LANES), 255, dtype=np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(net_norm(jnp.asarray(x)), np.float32),
+        np.asarray(normalize_u8(jnp.asarray(x)), np.float32))
