@@ -1,0 +1,267 @@
+// Native XLA-profiler bridge: parse .xplane.pb trace files into
+// per-op (name, start_ns, end_ns) interval lists.
+//
+// This is the TPU-native equivalent of the reference's CUPTI Activity
+// bridge (SURVEY.md §2.2 N1; reference utils/cupti.cpp:1-175): where
+// CUPTI streamed CUDA kernel records through callback buffers, the XLA
+// profiler (driven from Python via jax.profiler.start_trace/stop_trace)
+// writes an XSpace protobuf per host; this library decodes it natively
+// and exposes a flat event table over a C ABI (ctypes; pybind11 is not
+// available in this image).
+//
+// The decoder is a minimal protobuf wire-format walker — no protobuf
+// runtime dependency — using the XSpace schema's stable field numbers
+// (verified empirically against traces produced by this image's jax):
+//   XSpace.planes = 1
+//   XPlane: .name = 2, .lines = 3, .event_metadata = 4 (map: k=1 v=2)
+//   XEventMetadata: .name = 2
+//   XLine: .name = 2, .timestamp_ns = 3, .events = 4
+//   XEvent: .metadata_id = 1, .offset_ps = 2, .duration_ps = 3
+// Unknown fields of any wire type are skipped, so schema additions
+// don't break the parser.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Slice {
+  const uint8_t* p = nullptr;
+  size_t len = 0;
+};
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t Varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      const uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  // Returns false at end of buffer; on success fills field/wire/data.
+  bool Next(uint32_t* field, uint32_t* wire, Slice* data,
+            uint64_t* scalar) {
+    if (p >= end || !ok) return false;
+    const uint64_t key = Varint();
+    if (!ok) return false;
+    *field = static_cast<uint32_t>(key >> 3);
+    *wire = static_cast<uint32_t>(key & 7);
+    switch (*wire) {
+      case 0:  // varint
+        *scalar = Varint();
+        return ok;
+      case 2: {  // length-delimited
+        const uint64_t len = Varint();
+        // compare against remaining bytes; `p + len` could overflow
+        if (!ok || len > static_cast<uint64_t>(end - p))
+          return ok = false;
+        data->p = p;
+        data->len = static_cast<size_t>(len);
+        p += len;
+        return true;
+      }
+      case 5:  // fixed32
+        if (p + 4 > end) return ok = false;
+        p += 4;
+        return true;
+      case 1:  // fixed64
+        if (p + 8 > end) return ok = false;
+        p += 8;
+        return true;
+      default:
+        return ok = false;
+    }
+  }
+};
+
+struct Event {
+  std::string name;
+  std::string plane;
+  std::string line;
+  long long start_ns;
+  long long end_ns;
+};
+
+struct Result {
+  std::vector<Event> events;
+};
+
+void ParsePlane(Slice plane_bytes, const char* plane_filter,
+                Result* out) {
+  // pass 1: plane name + event-metadata map
+  std::string plane_name;
+  std::map<uint64_t, std::string> names;
+  std::vector<Slice> lines;
+  {
+    Cursor c{plane_bytes.p, plane_bytes.p + plane_bytes.len};
+    uint32_t f, w;
+    Slice d;
+    uint64_t s;
+    while (c.Next(&f, &w, &d, &s)) {
+      if (f == 2 && w == 2) {
+        plane_name.assign(reinterpret_cast<const char*>(d.p), d.len);
+      } else if (f == 3 && w == 2) {
+        lines.push_back(d);
+      } else if (f == 4 && w == 2) {
+        // map entry { key = 1 (varint), value = 2 (XEventMetadata) }
+        Cursor m{d.p, d.p + d.len};
+        uint64_t key = 0;
+        Slice val{};
+        uint32_t mf, mw;
+        Slice md;
+        uint64_t ms;
+        while (m.Next(&mf, &mw, &md, &ms)) {
+          if (mf == 1 && mw == 0) key = ms;
+          else if (mf == 2 && mw == 2) val = md;
+        }
+        if (val.p) {
+          Cursor em{val.p, val.p + val.len};
+          while (em.Next(&mf, &mw, &md, &ms)) {
+            if (mf == 2 && mw == 2) {
+              names[key].assign(reinterpret_cast<const char*>(md.p),
+                                md.len);
+              break;
+            }
+          }
+        }
+      }
+    }
+  }
+  if (plane_filter && *plane_filter &&
+      plane_name.find(plane_filter) == std::string::npos)
+    return;
+
+  for (const Slice& line_bytes : lines) {
+    std::string line_name;
+    long long line_ts_ns = 0;
+    std::vector<Slice> events;
+    Cursor c{line_bytes.p, line_bytes.p + line_bytes.len};
+    uint32_t f, w;
+    Slice d;
+    uint64_t s;
+    while (c.Next(&f, &w, &d, &s)) {
+      if (f == 2 && w == 2)
+        line_name.assign(reinterpret_cast<const char*>(d.p), d.len);
+      else if (f == 3 && w == 0)
+        line_ts_ns = static_cast<long long>(s);
+      else if (f == 4 && w == 2)
+        events.push_back(d);
+    }
+    for (const Slice& ev : events) {
+      uint64_t metadata_id = 0, offset_ps = 0, duration_ps = 0;
+      Cursor e{ev.p, ev.p + ev.len};
+      while (e.Next(&f, &w, &d, &s)) {
+        if (w != 0) continue;
+        if (f == 1) metadata_id = s;
+        else if (f == 2) offset_ps = s;
+        else if (f == 3) duration_ps = s;
+      }
+      Event item;
+      const auto it = names.find(metadata_id);
+      item.name = it != names.end()
+                      ? it->second
+                      : "metadata:" + std::to_string(metadata_id);
+      item.plane = plane_name;
+      item.line = line_name;
+      item.start_ns =
+          line_ts_ns + static_cast<long long>(offset_ps / 1000);
+      item.end_ns =
+          item.start_ns + static_cast<long long>(duration_ps / 1000);
+      out->events.push_back(std::move(item));
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse `path`; keep only planes whose name contains `plane_filter`
+// (NULL/"" = all planes).  Returns a handle or NULL on error.
+void* rnb_xplane_load(const char* path, const char* plane_filter) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  const long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (size <= 0) {
+    fclose(f);
+    return nullptr;
+  }
+  std::vector<uint8_t> buf(static_cast<size_t>(size));
+  const bool read_ok =
+      fread(buf.data(), 1, buf.size(), f) == buf.size();
+  fclose(f);
+  if (!read_ok) return nullptr;
+
+  Result* result = new Result;
+  Cursor c{buf.data(), buf.data() + buf.size()};
+  uint32_t field, wire;
+  Slice data;
+  uint64_t scalar;
+  while (c.Next(&field, &wire, &data, &scalar)) {
+    if (field == 1 && wire == 2) ParsePlane(data, plane_filter, result);
+  }
+  if (!c.ok && result->events.empty()) {
+    delete result;
+    return nullptr;
+  }
+  return result;
+}
+
+long long rnb_xplane_num_events(void* h) {
+  return h ? static_cast<long long>(
+                 static_cast<Result*>(h)->events.size())
+           : 0;
+}
+
+static const Event* GetEvent(void* h, long long i) {
+  if (!h) return nullptr;
+  Result* r = static_cast<Result*>(h);
+  if (i < 0 || static_cast<size_t>(i) >= r->events.size())
+    return nullptr;
+  return &r->events[static_cast<size_t>(i)];
+}
+
+const char* rnb_xplane_event_name(void* h, long long i) {
+  const Event* e = GetEvent(h, i);
+  return e ? e->name.c_str() : nullptr;
+}
+
+const char* rnb_xplane_event_plane(void* h, long long i) {
+  const Event* e = GetEvent(h, i);
+  return e ? e->plane.c_str() : nullptr;
+}
+
+const char* rnb_xplane_event_line(void* h, long long i) {
+  const Event* e = GetEvent(h, i);
+  return e ? e->line.c_str() : nullptr;
+}
+
+long long rnb_xplane_event_start_ns(void* h, long long i) {
+  const Event* e = GetEvent(h, i);
+  return e ? e->start_ns : -1;
+}
+
+long long rnb_xplane_event_end_ns(void* h, long long i) {
+  const Event* e = GetEvent(h, i);
+  return e ? e->end_ns : -1;
+}
+
+void rnb_xplane_free(void* h) { delete static_cast<Result*>(h); }
+
+}  // extern "C"

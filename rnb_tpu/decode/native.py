@@ -143,6 +143,12 @@ class DecodePool:
         return ticket, out
 
     def wait(self, ticket: int, path: str = "<submitted>") -> None:
+        with self._pending_lock:
+            if ticket not in self._pending:
+                # the native side blocks forever on unknown/retired
+                # tickets; fail fast here instead
+                raise ValueError("unknown or already-waited ticket %r"
+                                 % (ticket,))
         try:
             _check(self._lib.rnb_pool_wait(self._pool, ticket), path)
         finally:

@@ -1,0 +1,278 @@
+"""Device-op profiler bridge: per-op (name, start_ns, end_ns) timelines.
+
+The TPU-native equivalent of the reference's CUPTI Activity bridge
+(SURVEY.md §2.2 N1; reference utils/cupti.cpp exposes
+``initialize()/flush()/report()`` and the smoke test at
+test_cupti.py:1-21).  Same three-call contract here:
+
+* :func:`initialize` — start XLA trace capture (jax.profiler);
+* :func:`flush` — stop capture, forcing buffered trace data to disk;
+* :func:`report` — parse the captured ``.xplane.pb`` and return
+  ``[(op_name, start_ns, end_ns)]``, clearing captured state.
+
+Parsing is done natively (native/xplane.cpp via ctypes) when the
+library is built (``make -C native``); a pure-Python wire-format
+walker with identical output covers environments without a toolchain.
+
+On TPU backends the interesting planes are ``/device:TPU:*`` (XLA ops
+on the core timeline); on CPU test backends there are only host
+planes.  ``report(plane_filter=...)`` selects; the default prefers
+device planes and falls back to everything.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import os
+import shutil
+import tempfile
+import threading
+from typing import List, Optional, Tuple
+
+Interval = Tuple[str, int, int]
+
+_state_lock = threading.Lock()
+_trace_dir: Optional[str] = None
+_trace_dir_owned = False  # True only for dirs we mkdtemp'd ourselves
+_capturing = False
+_lib_cache = None
+_lib_checked = False
+
+DEVICE_PLANE_MARKER = "/device:"
+
+
+def _xplane_lib():
+    global _lib_cache, _lib_checked
+    if _lib_checked:
+        return _lib_cache
+    _lib_checked = True
+    path = os.environ.get("RNB_NATIVE_XPLANE_LIB")
+    if not path:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo_root, "native", "build",
+                            "librnb_xplane.so")
+    if os.environ.get("RNB_DISABLE_NATIVE") or not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.rnb_xplane_load.restype = ctypes.c_void_p
+    lib.rnb_xplane_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.rnb_xplane_num_events.restype = ctypes.c_longlong
+    lib.rnb_xplane_num_events.argtypes = [ctypes.c_void_p]
+    for fn in ("rnb_xplane_event_name", "rnb_xplane_event_plane",
+               "rnb_xplane_event_line"):
+        getattr(lib, fn).restype = ctypes.c_char_p
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    for fn in ("rnb_xplane_event_start_ns", "rnb_xplane_event_end_ns"):
+        getattr(lib, fn).restype = ctypes.c_longlong
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.rnb_xplane_free.restype = None
+    lib.rnb_xplane_free.argtypes = [ctypes.c_void_p]
+    _lib_cache = lib
+    return lib
+
+
+def initialize(trace_dir: Optional[str] = None) -> None:
+    """Begin capturing device activity (reference cupti.initialize)."""
+    global _trace_dir, _trace_dir_owned, _capturing
+    import jax
+    with _state_lock:
+        if _capturing:
+            raise RuntimeError("profiler already initialized")
+        _trace_dir_owned = trace_dir is None
+        _trace_dir = trace_dir or tempfile.mkdtemp(prefix="rnb_xprof_")
+        jax.profiler.start_trace(_trace_dir)
+        _capturing = True
+
+
+def flush() -> None:
+    """Stop capture and force trace buffers to disk (cupti.flush)."""
+    global _capturing
+    import jax
+    with _state_lock:
+        if not _capturing:
+            return
+        jax.profiler.stop_trace()
+        _capturing = False
+
+
+def _xplane_files() -> List[str]:
+    if _trace_dir is None:
+        return []
+    return sorted(glob.glob(
+        os.path.join(_trace_dir, "plugins", "profile", "*",
+                     "*.xplane.pb")))
+
+
+def _parse_native(lib, path: str, plane_filter: str) -> List[Interval]:
+    handle = lib.rnb_xplane_load(path.encode(),
+                                 plane_filter.encode())
+    if not handle:
+        return []
+    try:
+        n = lib.rnb_xplane_num_events(handle)
+        out = []
+        for i in range(n):
+            name = lib.rnb_xplane_event_name(handle, i)
+            out.append((name.decode("utf-8", "replace"),
+                        int(lib.rnb_xplane_event_start_ns(handle, i)),
+                        int(lib.rnb_xplane_event_end_ns(handle, i))))
+        return out
+    finally:
+        lib.rnb_xplane_free(handle)
+
+
+# --- pure-Python fallback wire-format walker (same field numbers the
+# native parser uses; see native/xplane.cpp header comment) ---------------
+
+def _fields(buf: bytes):
+    i, n = 0, len(buf)
+    while i < n:
+        key = shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val = shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, val
+        elif wire == 2:
+            ln = shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            i += 4
+            yield field, None
+        elif wire == 1:
+            i += 8
+            yield field, None
+        else:
+            raise ValueError("bad wire type %d" % wire)
+
+
+def _parse_python(path: str, plane_filter: str) -> List[Interval]:
+    # Degrade like the native parser on malformed input: return what
+    # was decoded before the corruption instead of raising.
+    out: List[Interval] = []
+    try:
+        _parse_python_into(path, plane_filter, out)
+    except (IndexError, ValueError):
+        pass
+    return out
+
+
+def _parse_python_into(path: str, plane_filter: str,
+                       out: List[Interval]) -> None:
+    with open(path, "rb") as f:
+        data = f.read()
+    for field, plane in _fields(data):
+        if field != 1 or not isinstance(plane, bytes):
+            continue
+        plane_name = ""
+        names = {}
+        lines = []
+        for f2, v2 in _fields(plane):
+            if f2 == 2 and isinstance(v2, bytes):
+                plane_name = v2.decode("utf-8", "replace")
+            elif f2 == 3 and isinstance(v2, bytes):
+                lines.append(v2)
+            elif f2 == 4 and isinstance(v2, bytes):
+                key, val = 0, None
+                for f3, v3 in _fields(v2):
+                    if f3 == 1 and isinstance(v3, int):
+                        key = v3
+                    elif f3 == 2 and isinstance(v3, bytes):
+                        val = v3
+                if val is not None:
+                    for f4, v4 in _fields(val):
+                        if f4 == 2 and isinstance(v4, bytes):
+                            names[key] = v4.decode("utf-8", "replace")
+                            break
+        if plane_filter and plane_filter not in plane_name:
+            continue
+        for line in lines:
+            ts_ns = 0
+            events = []
+            for f2, v2 in _fields(line):
+                if f2 == 3 and isinstance(v2, int):
+                    ts_ns = v2
+                elif f2 == 4 and isinstance(v2, bytes):
+                    events.append(v2)
+            for ev in events:
+                mid = off_ps = dur_ps = 0
+                for f3, v3 in _fields(ev):
+                    if not isinstance(v3, int):
+                        continue
+                    if f3 == 1:
+                        mid = v3
+                    elif f3 == 2:
+                        off_ps = v3
+                    elif f3 == 3:
+                        dur_ps = v3
+                start = ts_ns + off_ps // 1000
+                out.append((names.get(mid, "metadata:%d" % mid), start,
+                            start + dur_ps // 1000))
+    return out
+
+
+def report(plane_filter: Optional[str] = None,
+           keep_trace: bool = False) -> List[Interval]:
+    """-> captured ``[(op_name, start_ns, end_ns)]``; clears state.
+
+    ``plane_filter`` keeps only planes whose name contains the string.
+    Default: device planes if any exist, else all planes (so the same
+    smoke test runs on TPU and on the CPU test backend).  Like the
+    reference's ``report()`` (utils/cupti.cpp:160-166) this drains:
+    captured trace files are deleted unless ``keep_trace``.
+    """
+    global _trace_dir
+    files = _xplane_files()
+    lib = _xplane_lib()
+    intervals: List[Interval] = []
+    for path in files:
+        if plane_filter is not None:
+            wanted = [plane_filter]
+        else:
+            wanted = [DEVICE_PLANE_MARKER]
+        for filt in wanted:
+            got = (_parse_native(lib, path, filt) if lib is not None
+                   else _parse_python(path, filt))
+            if plane_filter is None and not got:
+                got = (_parse_native(lib, path, "") if lib is not None
+                       else _parse_python(path, ""))
+            intervals.extend(got)
+    intervals.sort(key=lambda t: t[1])
+    with _state_lock:
+        if not keep_trace and _trace_dir and not _capturing:
+            if _trace_dir_owned:
+                shutil.rmtree(_trace_dir, ignore_errors=True)
+            else:
+                # caller-supplied dir: drain only the profile subtree
+                # the capture wrote, never the caller's other artifacts
+                shutil.rmtree(os.path.join(_trace_dir, "plugins",
+                                           "profile"),
+                              ignore_errors=True)
+            _trace_dir = None
+    return intervals

@@ -123,6 +123,20 @@ def test_pool_concurrent_decodes(tmp_path, native):
         pool.close()
 
 
+def test_pool_double_wait_fails_fast(tmp_path, native):
+    from rnb_tpu.decode.native import DecodePool
+    p = tmp_path / "dw.y4m"
+    _write_video(p, n=4)
+    pool = DecodePool(num_threads=1)
+    try:
+        ticket, _ = pool.submit(str(p), [0], 2, 16, 16)
+        pool.wait(ticket)
+        with pytest.raises(ValueError):
+            pool.wait(ticket)  # retired ticket must not hang
+    finally:
+        pool.close()
+
+
 def test_get_decoder_prefers_native(tmp_path, native):
     from rnb_tpu.decode import get_decoder
     from rnb_tpu.decode.native import NativeY4MDecoder
