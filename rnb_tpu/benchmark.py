@@ -52,7 +52,8 @@ def run_benchmark(config_path: str,
                   log_base: str = "logs",
                   print_progress: bool = True,
                   seed: Optional[int] = None,
-                  job_id: Optional[str] = None) -> BenchmarkResult:
+                  job_id: Optional[str] = None,
+                  xprof: bool = False) -> BenchmarkResult:
     """Programmatic entry used by the CLI, tests and bench.py."""
     from rnb_tpu.client import bulk_client, poisson_client
     from rnb_tpu.config import load_config
@@ -149,6 +150,16 @@ def run_benchmark(config_path: str,
     for t in threads:
         t.start()
 
+    if xprof:
+        # device-op tracing of the measured window only: capture starts
+        # while every runner is still blocked on the start barrier (model
+        # warm-up already happened in their ctors), so neither the trace
+        # nor time_start is skewed by profiler setup. The reference left
+        # its CUPTI bridge unwired from the runner (SURVEY.md §5
+        # tracing); here the same three-call contract covers the job.
+        from rnb_tpu import profiler
+        profiler.initialize(os.path.join(logroot(job_id, base=log_base),
+                                         "xprof"))
     sta_bar.wait()
     time_start = time.time()
     if print_progress:
@@ -157,6 +168,17 @@ def run_benchmark(config_path: str,
     fin_bar.wait()
     time_end = time.time()
     total_time = time_end - time_start
+    if xprof:
+        from rnb_tpu import profiler
+        profiler.flush()
+        ops = profiler.report(keep_trace=True)
+        with open(os.path.join(logroot(job_id, base=log_base),
+                               "xprof-ops.txt"), "w") as f:
+            for name, t0, t1 in ops:
+                f.write("%d %d %s\n" % (t0, t1, name))
+        if print_progress:
+            print("xprof: %d device-op intervals -> xprof-ops.txt"
+                  % len(ops))
     if print_progress:
         print("FINISH! %f" % time_end)
         print("Time: %f sec" % total_time)
@@ -214,6 +236,9 @@ def main(argv=None) -> int:
                         help="'cpu' forces the (virtual) CPU backend")
     parser.add_argument("--log-base", type=str, default="logs")
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--xprof", action="store_true",
+                        help="Capture device-op timelines for the "
+                             "measured window into <logdir>/xprof-ops.txt")
     args = parser.parse_args(argv)
 
     if args.platform == "cpu":
@@ -237,6 +262,7 @@ def main(argv=None) -> int:
         queue_size=args.queue_size,
         log_base=args.log_base,
         seed=args.seed,
+        xprof=args.xprof,
     )
     print("Throughput: %.3f videos/s" % result.throughput_vps)
     print("Logs: %s" % result.log_dir)

@@ -131,16 +131,33 @@ class DecodePool:
         """-> (ticket, out_array); pass ticket to :meth:`wait`."""
         out = np.empty((len(clip_starts), consecutive_frames, height,
                         width, 3), dtype=np.uint8)
+        ticket = self.submit_into(path, clip_starts, consecutive_frames,
+                                  out)
+        return ticket, out
+
+    def submit_into(self, path: str, clip_starts: List[int],
+                    consecutive_frames: int, out: np.ndarray) -> int:
+        """Decode into a caller-provided C-contiguous uint8 view of
+        shape (len(clip_starts), consecutive_frames, H, W, 3) — lets
+        one logical decode fan out over the pool by submitting chunks
+        that target disjoint slices of a single batch buffer."""
+        if (out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"]
+                or out.shape[:2] != (len(clip_starts),
+                                     consecutive_frames)
+                or out.ndim != 5 or out.shape[4] != 3):
+            raise ValueError("bad output buffer %r for %d clips x %d "
+                             "frames" % (out.shape, len(clip_starts),
+                                         consecutive_frames))
         starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
         ticket = self._lib.rnb_pool_submit(
             self._pool, path.encode(), starts, len(clip_starts),
-            consecutive_frames, width, height,
+            consecutive_frames, out.shape[3], out.shape[2],
             out.ctypes.data_as(ctypes.c_char_p))
         if ticket <= 0:
             raise RuntimeError("native pool rejected submit for %r" % path)
         with self._pending_lock:
             self._pending[ticket] = (out, starts)
-        return ticket, out
+        return ticket
 
     def wait(self, ticket: int, path: str = "<submitted>") -> None:
         with self._pending_lock:
@@ -161,15 +178,29 @@ class DecodePool:
             self._pool = None
 
 
-class NativeY4MDecoder(VideoDecoder):
-    """VideoDecoder backed by the C++ library (sync calls)."""
+#: one logical decode fans out over the shared pool only past this many
+#: clips — tiny requests aren't worth the submit/wait round trip
+POOL_SPLIT_MIN_CLIPS = 4
 
-    def __init__(self):
+
+class NativeY4MDecoder(VideoDecoder):
+    """VideoDecoder backed by the C++ library.
+
+    Single-clip requests decode synchronously on the calling thread;
+    larger requests split their clip list into chunks fanned out over
+    the process-shared :class:`DecodePool`, each chunk writing a
+    disjoint slice of the one output batch — the intra-video
+    parallelism NVVL got from async NVDEC (reference README.md:46-110).
+    """
+
+    def __init__(self, use_pool: bool = True):
         lib = load_native()
         if lib is None:
             raise RuntimeError("native decode library not built; run "
                                "`make -C native`")
         self._lib = lib
+        self._use_pool = use_pool and not os.environ.get(
+            "RNB_DECODE_NO_POOL")
         self._count_cache = {}
 
     def num_frames(self, video: str) -> int:
@@ -186,6 +217,29 @@ class NativeY4MDecoder(VideoDecoder):
                      height: int = DEFAULT_HEIGHT) -> np.ndarray:
         out = np.empty((len(clip_starts), consecutive_frames, height,
                         width, 3), dtype=np.uint8)
+        if self._use_pool and len(clip_starts) >= POOL_SPLIT_MIN_CLIPS:
+            pool = DecodePool.shared()
+            chunk = max(1, -(-len(clip_starts) // pool.num_threads))
+            tickets = []
+            first_error = None
+            try:
+                for lo in range(0, len(clip_starts), chunk):
+                    hi = min(lo + chunk, len(clip_starts))
+                    tickets.append(pool.submit_into(
+                        video, clip_starts[lo:hi], consecutive_frames,
+                        out[lo:hi]))
+            finally:
+                # retire EVERY submitted ticket even if one fails —
+                # un-waited tickets would pin the batch buffer in
+                # _pending and leak done-map entries in the native pool
+                for ticket in tickets:
+                    try:
+                        pool.wait(ticket, video)
+                    except ValueError as e:
+                        first_error = first_error or e
+            if first_error is not None:
+                raise first_error
+            return out
         starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
         _check(self._lib.rnb_y4m_decode_clips(
             video.encode(), starts, len(clip_starts), consecutive_frames,
