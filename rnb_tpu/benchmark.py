@@ -42,6 +42,11 @@ class BenchmarkResult:
     termination_flag: int
     throughput_vps: float
     log_dir: str
+    #: end-to-end per-request latency percentiles (ms) over every
+    #: final-step instance, steady-state records only; None when the
+    #: run produced too few records
+    p50_latency_ms: Optional[float] = None
+    p99_latency_ms: Optional[float] = None
 
 
 def run_benchmark(config_path: str,
@@ -76,6 +81,7 @@ def run_benchmark(config_path: str,
     fin_bar = threading.Barrier(bar_total, timeout=BARRIER_TIMEOUT_S)
     counter = InferenceCounter()
     termination = TerminationState()
+    summary_sink: list = []
 
     # bulk mode pre-enqueues everything; size the queues accordingly
     # (reference benchmark.py:209 — but unlike the reference, account
@@ -140,6 +146,7 @@ def run_benchmark(config_path: str,
                     sync_outputs=not step.async_dispatch,
                     log_base=log_base,
                     model_kwargs=model_kwargs,
+                    summary_sink=summary_sink if is_final else None,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -151,13 +158,20 @@ def run_benchmark(config_path: str,
         t.start()
 
     if xprof:
-        # device-op tracing of the measured window only: capture starts
-        # while every runner is still blocked on the start barrier (model
-        # warm-up already happened in their ctors), so neither the trace
-        # nor time_start is skewed by profiler setup. The reference left
-        # its CUPTI bridge unwired from the runner (SURVEY.md §5
-        # tracing); here the same three-call contract covers the job.
+        # device-op tracing of the measured window only: wait until
+        # every other participant is parked on the start barrier (model
+        # compile/warm-up happens in the runner ctors BEFORE they reach
+        # it) so the trace contains no warm-up ops, then start capture
+        # before releasing the barrier so neither the trace nor
+        # time_start is skewed by profiler setup. The reference left its
+        # CUPTI bridge unwired from the runner (SURVEY.md §5 tracing);
+        # here the same three-call contract covers the job.
         from rnb_tpu import profiler
+        deadline = time.time() + BARRIER_TIMEOUT_S
+        while sta_bar.n_waiting < bar_total - 1:
+            if time.time() > deadline:
+                break  # let sta_bar.wait() raise the real timeout
+            time.sleep(0.01)
         profiler.initialize(os.path.join(logroot(job_id, base=log_base),
                                          "xprof"))
     sta_bar.wait()
@@ -199,6 +213,19 @@ def run_benchmark(config_path: str,
                     os.path.join(logroot(job_id, base=log_base),
                                  os.path.basename(config_path)))
 
+    # aggregate end-to-end latency percentiles over every final-step
+    # instance, skipping warm records per the summary convention
+    from rnb_tpu.runner import NUM_SUMMARY_SKIPS
+    from rnb_tpu.telemetry import latency_percentiles
+    latencies = []
+    for s in summary_sink:
+        latencies.extend(s.latencies_ms(NUM_SUMMARY_SKIPS))
+    pct = latency_percentiles(latencies)
+    p50, p99 = pct.get(50.0), pct.get(99.0)
+    if pct and print_progress:
+        print("Latency p50: %.3f ms  p99: %.3f ms (%d steady-state "
+              "records)" % (p50, p99, len(latencies)))
+
     return BenchmarkResult(
         job_id=job_id,
         total_time_s=total_time,
@@ -207,6 +234,8 @@ def run_benchmark(config_path: str,
         throughput_vps=(counter.value / total_time if total_time > 0
                         else 0.0),
         log_dir=logroot(job_id, base=log_base),
+        p50_latency_ms=p50,
+        p99_latency_ms=p99,
     )
 
 

@@ -74,6 +74,9 @@ class RunnerContext:
     sync_outputs: bool = True
     log_base: str = "logs"
     model_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # final-step instances append their TimeCardSummary here so the
+    # controller can report aggregate latency percentiles
+    summary_sink: Optional[List] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -273,6 +276,8 @@ def runner(ctx: RunnerContext) -> None:
             pass
 
         if summary is not None:
+            if ctx.summary_sink is not None:
+                ctx.summary_sink.append(summary)
             with open(logname(ctx.job_id, ctx.device.label, ctx.group_idx,
                               ctx.instance_idx, base=ctx.log_base),
                       "w") as f:

@@ -47,6 +47,19 @@ def logname(job_id: str, device_label: str, group_idx: int, instance_idx: int,
         "%s-group%d-%d.txt" % (safe, group_idx, instance_idx))
 
 
+def latency_percentiles(latencies_ms: Sequence[float],
+                        percentiles=(50.0, 99.0)):
+    """{percentile: value_ms} over a latency sample; {} when empty.
+
+    The one percentile convention shared by per-instance summaries and
+    the controller's cross-instance aggregation (rnb_tpu.benchmark).
+    """
+    import numpy as np
+    if not latencies_ms:
+        return {}
+    return {p: float(np.percentile(latencies_ms, p)) for p in percentiles}
+
+
 class TimeCard:
     """An ordered event->timestamp record that rides along with a request.
 
@@ -218,6 +231,23 @@ class TimeCardSummary:
                  - np.asarray(self.summary[prv][num_skips:])) * 1000.0)
             out.append((prv, nxt, float(gap)))
         return out
+
+    def latencies_ms(self, num_skips: int = 0):
+        """Per-record end-to-end latency (first event -> last event) in
+        ms over records after ``num_skips``."""
+        import numpy as np
+        if not self.keys or len(self.keys) < 2:
+            return []
+        first = np.asarray(self.summary[self.keys[0]][num_skips:])
+        last = np.asarray(self.summary[self.keys[-1]][num_skips:])
+        return ((last - first) * 1000.0).tolist()
+
+    def latency_percentiles_ms(self, num_skips: int = 0,
+                               percentiles=(50.0, 99.0)):
+        """End-to-end latency percentiles in ms; {} when there are not
+        enough records."""
+        return latency_percentiles(self.latencies_ms(num_skips),
+                                   percentiles)
 
     def print_summary(self, num_skips: int) -> None:
         gaps = self.mean_gaps_ms(num_skips)

@@ -28,8 +28,9 @@ from rnb_tpu.decode import write_y4m  # noqa: E402
 
 
 def synth_frames(num_frames: int, height: int, width: int,
-                 seed: int) -> np.ndarray:
-    """Moving diagonal gradients + per-video noise floor."""
+                 seed) -> np.ndarray:
+    """Moving diagonal gradients + per-video noise floor. ``seed`` is
+    anything ``np.random.default_rng`` accepts (ints or sequences)."""
     rng = np.random.default_rng(seed)
     phase = rng.uniform(0, 2 * np.pi, size=3)
     speed = rng.uniform(0.5, 2.0, size=3)
@@ -63,8 +64,9 @@ def main(argv=None) -> int:
         os.makedirs(label_dir, exist_ok=True)
         for vi in range(args.videos_per_label):
             path = os.path.join(label_dir, "video%04d.y4m" % vi)
+            # sequence seed: collision-free for any label/video counts
             frames = synth_frames(args.frames, height, width,
-                                  seed=args.seed * 100003 + li * 1009 + vi)
+                                  seed=[args.seed, li, vi])
             write_y4m(path, frames)
             count += 1
     print("wrote %d videos under %s" % (count, args.root))

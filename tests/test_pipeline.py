@@ -48,6 +48,9 @@ def test_bulk_end_to_end(tmp_path):
     assert "pipeline.json" in files
     reports = [f for f in files if "group" in f]
     assert len(reports) == 1
+    # 25 videos > NUM_SUMMARY_SKIPS: latency percentiles must be present
+    assert res.p50_latency_ms is not None
+    assert res.p99_latency_ms >= res.p50_latency_ms > 0
     with open(os.path.join(res.log_dir, reports[0])) as f:
         lines = f.read().strip().split("\n")
     header = lines[0].split()

@@ -189,6 +189,21 @@ def test_merge_rejects_unforked_and_duplicate_sub_ids():
         TimeCard.merge([parent.fork(0), parent.fork(0)])
 
 
+def test_latency_percentiles():
+    s = TimeCardSummary()
+    for i in range(20):
+        tc = TimeCard(i)
+        tc.timings["a"] = 100.0 + i
+        tc.timings["b"] = 100.0 + i + 0.010 * (i + 1)  # 10..200 ms
+        s.register(tc)
+    pct = s.latency_percentiles_ms(num_skips=0, percentiles=(50.0, 99.0))
+    assert 100.0 < pct[50.0] < 110.0
+    assert pct[99.0] > 190.0
+    # after skipping everything: no records -> {}
+    assert s.latency_percentiles_ms(num_skips=20) == {}
+    assert TimeCardSummary().latency_percentiles_ms() == {}
+
+
 def test_mean_gaps_not_enough_records():
     s = TimeCardSummary()
     tc = TimeCard(0)
