@@ -111,10 +111,15 @@ class R2P1DLoader(StageModel):
     def __init__(self, device, max_clips: int = MAX_CLIPS,
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
                  num_clips_population=None, weights=None,
-                 num_warmups: int = NUM_WARMUPS, **kwargs):
+                 num_warmups: int = NUM_WARMUPS,
+                 raw_output: bool = False, **kwargs):
         super().__init__(device)
         import jax
         self._jax_device = _resolve(device)
+        #: raw mode emits the padded uint8 batch itself (half the bytes
+        #: of bf16 on the wire) for consumers that normalize on their
+        #: own mesh, e.g. R2P1DMeshRunner
+        self.raw_output = bool(raw_output)
         sampler_kwargs = {}
         if num_clips_population is not None:
             sampler_kwargs["num_clips_population"] = num_clips_population
@@ -124,12 +129,15 @@ class R2P1DLoader(StageModel):
                                     **sampler_kwargs)
         self.max_clips = int(max_clips)
         self.consecutive_frames = int(consecutive_frames)
-        self._preprocess = _shared_preprocess(self._jax_device)
-        # warm-up: compile the preprocess and fault in the decode path
-        dummy = np.zeros(self._batch_shape(), dtype=np.uint8)
-        for _ in range(num_warmups):
-            jax.block_until_ready(self._preprocess(
-                jax.device_put(dummy, self._jax_device)))
+        if self.raw_output:
+            self._preprocess = None  # consumer normalizes on its mesh
+        else:
+            self._preprocess = _shared_preprocess(self._jax_device)
+            # warm-up: compile the preprocess, fault in the transfer path
+            dummy = np.zeros(self._batch_shape(), dtype=np.uint8)
+            for _ in range(num_warmups):
+                jax.block_until_ready(self._preprocess(
+                    jax.device_put(dummy, self._jax_device)))
 
     def _batch_shape(self):
         return (self.max_clips, self.consecutive_frames, FRAME_HW,
@@ -157,6 +165,8 @@ class R2P1DLoader(StageModel):
         padded = np.zeros(self._batch_shape(), dtype=np.uint8)
         padded[:n] = clips
         device_u8 = jax.device_put(padded, self._jax_device)
+        if self.raw_output:
+            return (PaddedBatch(device_u8, n),), None, time_card
         batch = self._preprocess(device_u8)
         return (PaddedBatch(batch, n),), None, time_card
 
@@ -271,6 +281,77 @@ class R2P1DSingleStep(StageModel):
         (logits,), _, time_card = self.net((pb,), None, time_card)
         valid = np.asarray(logits.data)[: logits.valid]
         pred = int(valid.sum(axis=0).argmax())
+        return None, pred, time_card
+
+
+class R2P1DMeshRunner(StageModel):
+    """Clip-sharded inference stage over a device sub-mesh.
+
+    The TPU-native successor to the reference's segment-parallel
+    topology (config/r2p1d-segment.json: loader fans each video out as
+    ``num_segments`` row-splits to replica processes, a host aggregator
+    re-sums the logits — reference runner.py:138-173,
+    models/r2p1d/model.py:238-285). Here the split, the compute and the
+    merge are ONE compiled program over an ``sp`` mesh axis: every core
+    computes logits for its clip shard and a ``psum`` over ICI reduces
+    them on-device — no queue fan-out, no TimeCard forks, no host
+    aggregator hop.
+
+    Config: home the stage on one device (its executor thread) and pass
+    ``mesh_devices`` = the logical device indices forming the sub-mesh
+    (the home device should be among them). ``sp`` = len(mesh_devices)
+    must divide ``max_clips``. Consumes the loader's ``raw_output``
+    uint8 batches and emits the predicted class id (final-stage
+    contract, no tensor outputs).
+    """
+
+    def __init__(self, device, mesh_devices,
+                 max_clips: int = MAX_CLIPS,
+                 consecutive_frames: int = CONSECUTIVE_FRAMES,
+                 num_classes: int = KINETICS_CLASSES,
+                 layer_sizes=R18_LAYER_SIZES,
+                 num_warmups: int = NUM_WARMUPS,
+                 ckpt_path: Optional[str] = None, **kwargs):
+        super().__init__(device)
+        import numpy as _np
+        import jax
+        from jax.sharding import Mesh
+
+        from rnb_tpu.devices import DeviceSpec
+        from rnb_tpu.parallel.sharded import ShardedInference
+
+        devs = [DeviceSpec(int(d)).resolve() for d in mesh_devices]
+        mesh = Mesh(_np.array(devs).reshape(1, len(devs)), ("dp", "sp"))
+        self.max_clips = int(max_clips)
+        self.consecutive_frames = int(consecutive_frames)
+        self._si = ShardedInference(
+            mesh, max_clips=self.max_clips,
+            consecutive_frames=self.consecutive_frames,
+            num_classes=num_classes, layer_sizes=tuple(layer_sizes),
+            ckpt_path=ckpt_path)
+        dummy = np.zeros(self._si.batch_shape(1), np.uint8)
+        for _ in range(num_warmups):
+            vids, mask = self._si.place(dummy, [self.max_clips])
+            jax.block_until_ready(self._si.run(vids, mask))
+
+    def input_shape(self):
+        return ((self.max_clips, self.consecutive_frames, FRAME_HW,
+                 FRAME_HW, 3),)
+
+    @staticmethod
+    def output_shape():
+        return None
+
+    def __call__(self, tensors, non_tensors, time_card):
+        import jax
+        pb = tensors[0]
+        # re-home the loader's device batch straight onto the mesh
+        # sharding (device-to-device, ICI on hardware — no host bounce)
+        batch = pb.data.reshape((1,) + tuple(pb.data.shape))
+        vids = jax.device_put(batch, self._si.batch_sharding)
+        mask = self._si.place_mask([pb.valid])
+        logits = self._si.run(vids, mask)
+        pred = int(np.asarray(logits)[0].argmax())
         return None, pred, time_card
 
 

@@ -118,15 +118,20 @@ class ShardedInference:
         return (num_videos, self.max_clips, self.consecutive_frames,
                 self.frame_hw, self.frame_hw, 3)
 
+    def place_mask(self, valid_clips: Sequence[int]):
+        """The one clip-validity mask convention: float32 (videos,
+        max_clips), 1.0 = valid row, sharded like the batch."""
+        import jax
+        mask = np.zeros((len(valid_clips), self.max_clips), np.float32)
+        for i, n in enumerate(valid_clips):
+            mask[i, : int(n)] = 1.0
+        return jax.device_put(mask, self.batch_sharding)
+
     def place(self, videos_u8: np.ndarray, valid_clips: Sequence[int]):
         """Device-put a host batch + derive its mask, both sharded."""
         import jax
-        mask = np.zeros(videos_u8.shape[:2], np.float32)
-        for i, n in enumerate(valid_clips):
-            mask[i, : int(n)] = 1.0
         vids = jax.device_put(videos_u8, self.batch_sharding)
-        mask = jax.device_put(mask, self.batch_sharding)
-        return vids, mask
+        return vids, self.place_mask(valid_clips)
 
     def run(self, vids, mask):
         """-> per-video aggregated logits (videos, num_classes), fp32."""

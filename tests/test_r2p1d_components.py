@@ -100,7 +100,10 @@ def test_get_decoder_dispatch(tmp_path):
                       SyntheticDecoder)
     p = tmp_path / "real.y4m"
     write_y4m(str(p), np.zeros((1, 8, 8, 3), np.uint8))
-    assert isinstance(get_decoder(str(p)), Y4MDecoder)
+    # native C++ backend when built, numpy backend otherwise
+    from rnb_tpu.decode.native import NativeY4MDecoder, native_available
+    expected = NativeY4MDecoder if native_available() else Y4MDecoder
+    assert isinstance(get_decoder(str(p)), expected)
     q = tmp_path / "real.mp4"
     q.write_bytes(b"xxxx")
     with pytest.raises(ValueError, match="no decode backend"):
