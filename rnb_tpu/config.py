@@ -142,6 +142,12 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 "%s: 'num_segments' must be a positive integer" % where)
         _expect(not (final and num_segments != 1),
                 "the last step may not have multiple segments")
+        # variable bucketed row counts would make the per-segment split
+        # shapes unpredictable — every first-seen shape is a silent XLA
+        # recompile inside the measured window
+        _expect(not (num_segments > 1 and "row_buckets" in step_raw),
+                "%s: 'row_buckets' cannot be combined with "
+                "'num_segments' > 1" % where)
 
         num_shared_tensors = step_raw.get("num_shared_tensors")
         if num_shared_tensors is not None:

@@ -8,8 +8,10 @@ Capability parity with the reference stage library
   and immediately re-homes padded uint8 clips onto its TPU core where a
   jitted preprocess casts/normalizes to bfloat16 NDHWC — decode cost on
   host threads, math on device;
-* every stage computes on fixed max-shape batches with valid-row counts,
-  so XLA compiles once per topology (no dynamic clip-count shapes);
+* every stage computes on static-shape batches with valid-row counts —
+  one max shape per topology, or a small fixed set of row buckets when
+  ``row_buckets`` is configured — so XLA compiles a bounded number of
+  executables, never per-request shapes;
 * jitted appliers and device-resident weights are cached per
   (layer-range, device) so N replicas on one device share one
   executable and one parameter copy.
@@ -48,6 +50,20 @@ _preprocess_cache: Dict[tuple, Any] = {}
 def _resolve(device):
     """Accept a DeviceSpec or a raw jax.Device."""
     return device.resolve() if hasattr(device, "resolve") else device
+
+
+def _normalize_row_buckets(row_buckets, max_rows: int, what: str):
+    """Sorted, validated bucket tuple; (max_rows,) when disabled."""
+    if not row_buckets:
+        return (int(max_rows),)
+    buckets = sorted(int(b) for b in row_buckets)
+    if buckets[0] < 1 or len(set(buckets)) != len(buckets):
+        raise ValueError("row_buckets %r must be distinct positive row "
+                         "counts" % (row_buckets,))
+    if buckets[-1] != max_rows:
+        raise ValueError("row_buckets %r must end at %s=%d"
+                         % (row_buckets, what, max_rows))
+    return tuple(buckets)
 
 
 def _shared_apply(start: int, end: int, num_classes: int,
@@ -112,7 +128,8 @@ class R2P1DLoader(StageModel):
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
                  num_clips_population=None, weights=None,
                  num_warmups: int = NUM_WARMUPS,
-                 raw_output: bool = False, **kwargs):
+                 raw_output: bool = False,
+                 row_buckets=None, **kwargs):
         super().__init__(device)
         import jax
         self._jax_device = _resolve(device)
@@ -129,19 +146,44 @@ class R2P1DLoader(StageModel):
                                     **sampler_kwargs)
         self.max_clips = int(max_clips)
         self.consecutive_frames = int(consecutive_frames)
+        # Row bucketing: pad each video to the smallest bucket >= its
+        # clip count instead of always to max_clips. jit caches one
+        # executable per bucket shape, so with the default skewed clip
+        # population ([1,15]@[10,1], sampler.py) ~91% of videos move
+        # and compute 15x less than max-shape padding. Opt-in per
+        # config; downstream stages must warm the same buckets.
+        self.row_buckets = _normalize_row_buckets(row_buckets,
+                                                  self.max_clips,
+                                                  "max_clips")
+        if self.raw_output and len(self.row_buckets) > 1:
+            # raw consumers (R2P1DMeshRunner) shard the clip axis over a
+            # fixed mesh — a variable bucketed clip axis cannot satisfy
+            # the sp divisibility requirement
+            raise ValueError("row_buckets cannot be combined with "
+                             "raw_output: mesh consumers need a fixed "
+                             "clip axis")
         if self.raw_output:
             self._preprocess = None  # consumer normalizes on its mesh
         else:
             self._preprocess = _shared_preprocess(self._jax_device)
-            # warm-up: compile the preprocess, fault in the transfer path
-            dummy = np.zeros(self._batch_shape(), dtype=np.uint8)
-            for _ in range(num_warmups):
-                jax.block_until_ready(self._preprocess(
-                    jax.device_put(dummy, self._jax_device)))
+            # warm-up: compile the preprocess for every bucket shape and
+            # fault in the transfer path
+            for bucket in self.row_buckets:
+                dummy = np.zeros(self._batch_shape(bucket),
+                                 dtype=np.uint8)
+                for _ in range(num_warmups):
+                    jax.block_until_ready(self._preprocess(
+                        jax.device_put(dummy, self._jax_device)))
 
-    def _batch_shape(self):
-        return (self.max_clips, self.consecutive_frames, FRAME_HW,
-                FRAME_HW, 3)
+    def _batch_shape(self, rows: Optional[int] = None):
+        return (rows if rows is not None else self.max_clips,
+                self.consecutive_frames, FRAME_HW, FRAME_HW, 3)
+
+    def _bucket_for(self, n: int) -> int:
+        for bucket in self.row_buckets:
+            if n <= bucket:
+                return bucket
+        return self.row_buckets[-1]
 
     def input_shape(self):
         return None
@@ -162,7 +204,8 @@ class R2P1DLoader(StageModel):
                                      width=FRAME_HW, height=FRAME_HW)
         n = clips.shape[0]
         time_card.num_clips = n
-        padded = np.zeros(self._batch_shape(), dtype=np.uint8)
+        padded = np.zeros(self._batch_shape(self._bucket_for(n)),
+                          dtype=np.uint8)
         padded[:n] = clips
         device_u8 = jax.device_put(padded, self._jax_device)
         if self.raw_output:
@@ -189,7 +232,8 @@ class R2P1DRunner(StageModel):
                  max_rows: int = MAX_CLIPS,
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
                  num_warmups: int = NUM_WARMUPS,
-                 ckpt_path: Optional[str] = None, **kwargs):
+                 ckpt_path: Optional[str] = None,
+                 row_buckets=None, **kwargs):
         super().__init__(device)
         import jax
         if not (1 <= start_index <= end_index <= NUM_LAYERS):
@@ -220,10 +264,16 @@ class R2P1DRunner(StageModel):
         # real compile on the first request instead
         import jax.numpy as jnp
         warm_dtype = jnp.bfloat16 if self.start_index == 1 else jnp.float32
-        dummy = jax.device_put(
-            np.zeros(self._steady_shape, warm_dtype), self._jax_device)
-        for _ in range(num_warmups):
-            jax.block_until_ready(self._apply(self._variables, dummy))
+        # match the loader's row bucketing: compile one executable per
+        # bucket row count so no compile lands in the measured window
+        warm_rows = _normalize_row_buckets(row_buckets, self.max_rows,
+                                           "max_rows")
+        for rows in warm_rows:
+            dummy = jax.device_put(
+                np.zeros((rows,) + self._steady_shape[1:], warm_dtype),
+                self._jax_device)
+            for _ in range(num_warmups):
+                jax.block_until_ready(self._apply(self._variables, dummy))
 
     def input_shape(self):
         return (self._steady_shape,)

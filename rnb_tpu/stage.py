@@ -25,13 +25,16 @@ import numpy as np
 
 @dataclasses.dataclass
 class PaddedBatch:
-    """A fixed max-shape array plus the number of leading valid rows.
+    """A static-shape array plus the number of leading valid rows.
 
-    ``data`` always has the stage's declared output shape (row 0 is the
-    batch/clip axis); rows ``valid:`` are padding and must be ignored by
-    consumers. This is the TPU-idiomatic encoding of the reference's
-    max-shape shared tensors + ``valid_batch_sizes`` side array
-    (reference control.py:34-39).
+    ``data``'s row axis (axis 0, the batch/clip axis) is the stage's
+    declared max shape — or, under opt-in row bucketing, a smaller
+    bucket from a fixed per-config set (still static per bucket, one jit
+    executable each). Consumers must use ``valid``/``max_rows``, never
+    assume axis 0 equals the declared maximum. Rows ``valid:`` are
+    padding and must be ignored. This is the TPU-idiomatic encoding of
+    the reference's max-shape shared tensors + ``valid_batch_sizes``
+    side array (reference control.py:34-39).
     """
 
     data: Any          # numpy or jax.Array, shape = (max_rows, ...)
