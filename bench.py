@@ -11,7 +11,8 @@ Prints exactly ONE JSON line:
    "vs_baseline": N / 11.3}
 
 Env knobs: RNB_BENCH_VIDEOS (default 500), RNB_BENCH_CONFIG,
-RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk).
+RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk), RNB_BENCH_PLATFORM
+(e.g. "cpu" to force the CPU backend for smoke runs).
 """
 
 from __future__ import annotations
@@ -29,6 +30,12 @@ BASELINE_VIDEOS_PER_SEC = 500.0 / 44.249694
 def main() -> int:
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, repo_dir)
+    platform = os.environ.get("RNB_BENCH_PLATFORM")
+    if platform:
+        # env-var JAX_PLATFORMS alone is overridden by the site hook in
+        # some containers; the config knob wins
+        import jax
+        jax.config.update("jax_platforms", platform)
     num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "500"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",

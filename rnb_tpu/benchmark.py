@@ -34,6 +34,24 @@ from rnb_tpu.arg_utils import nonnegative_int, positive_int
 BARRIER_TIMEOUT_S = 1800.0  # generous: first TPU compile can be slow
 
 
+def _enable_compilation_cache() -> None:
+    """Persist XLA executables across processes so repeat runs (and the
+    round driver's bench invocations) skip the 20-40s first compile.
+    Off with RNB_NO_COMPILE_CACHE=1; dir overridable via
+    RNB_COMPILE_CACHE_DIR."""
+    if os.environ.get("RNB_NO_COMPILE_CACHE"):
+        return
+    import jax
+    cache_dir = os.environ.get(
+        "RNB_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "rnb_tpu_xla"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knobs: in-memory cache only
+
+
 @dataclass
 class BenchmarkResult:
     job_id: str
@@ -60,6 +78,7 @@ def run_benchmark(config_path: str,
                   job_id: Optional[str] = None,
                   xprof: bool = False) -> BenchmarkResult:
     """Programmatic entry used by the CLI, tests and bench.py."""
+    _enable_compilation_cache()
     from rnb_tpu.client import bulk_client, poisson_client
     from rnb_tpu.config import load_config
     from rnb_tpu.control import (ChannelFabric, InferenceCounter,

@@ -1,0 +1,37 @@
+"""bench.py driver contract: exactly one JSON line on stdout.
+
+The round driver runs ``python bench.py`` and records the single JSON
+line; this test pins the schema (metric/value/unit/vs_baseline) and the
+exit code using the reduced-geometry config via env overrides.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_prints_one_json_line(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "RNB_BENCH_VIDEOS": "6",
+        "RNB_BENCH_CONFIG": os.path.join(REPO, "configs",
+                                         "r2p1d-tiny.json"),
+        "RNB_BENCH_LOG_BASE": str(tmp_path / "logs"),
+        "RNB_BENCH_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, "stdout must be exactly one line: %r" % lines
+    payload = json.loads(lines[0])
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+    assert payload["metric"] == "videos_per_sec"
+    assert payload["unit"] == "videos/s"
+    assert payload["value"] > 0
+    assert payload["vs_baseline"] > 0
