@@ -195,9 +195,11 @@ extern "C" {
 void* rnb_xplane_load(const char* path, const char* plane_filter) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
-  fseek(f, 0, SEEK_END);
-  const long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  // fseeko/ftello: off_t stays 64-bit where long may be 32, so a >2GB
+  // trace is sized correctly (decode.cpp uses the same probe)
+  fseeko(f, 0, SEEK_END);
+  const off_t size = ftello(f);
+  fseeko(f, 0, SEEK_SET);
   if (size <= 0) {
     fclose(f);
     return nullptr;

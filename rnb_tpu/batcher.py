@@ -25,18 +25,33 @@ MAX_ROWS = 15  # max clips per fused batch, matches the loader's max
 class Batcher(StageModel):
     """Accumulate `batch` requests, then emit one fused PaddedBatch."""
 
-    def __init__(self, device, batch=1, **kwargs):
+    def __init__(self, device, batch=1, shapes=None, **kwargs):
         super().__init__(device)
+        del shapes  # consumed by output_shape_for; payloads carry shape
         self.batch = int(batch)
         self._tensors = []      # list of tuples of PaddedBatch
         self._time_cards = []
 
     def input_shape(self):
-        return ((MAX_ROWS, 3, 8, 112, 112),)
+        # NDHWC, the layout every payload in this framework flows
+        # (loader: models/r2p1d/model.py R2P1DLoader._batch_shape)
+        return ((MAX_ROWS, 8, 112, 112, 3),)
 
     @staticmethod
     def output_shape():
-        return ((MAX_ROWS, 3, 8, 112, 112),)
+        return ((MAX_ROWS, 8, 112, 112, 3),)
+
+    @classmethod
+    def output_shape_for(cls, shapes=None, max_rows: int = MAX_ROWS,
+                         consecutive_frames: int = 8,
+                         frame_hw: int = 112, **_kwargs):
+        # the batcher is payload-agnostic — it re-packs whatever its
+        # upstream emits — so non-flagship topologies declare the wire
+        # shapes explicitly via a `shapes` config key
+        if shapes:
+            return tuple(tuple(int(d) for d in s) for s in shapes)
+        return ((int(max_rows), int(consecutive_frames),
+                 frame_hw, frame_hw, 3),)
 
     def __call__(self, tensors, non_tensors, time_card):
         if self.batch <= 1:

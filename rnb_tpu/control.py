@@ -265,8 +265,9 @@ class ChannelFabric:
     stage model declares tensor outputs (``output_shape() is not None``;
     None means no ring is allocated — distinct from an empty tuple,
     reference runner_model.py:31-46). Ring shapes come from the stage
-    class's static ``output_shape()`` shrunk by the step's
-    ``num_segments``.
+    class's config-aware ``output_shape_for(**model_kwargs)`` —
+    evaluated per group, since group extras may override step extras —
+    shrunk by the step's ``num_segments``.
     """
 
     def __init__(self, pipeline: PipelineConfig, queue_size: int):
@@ -314,17 +315,18 @@ class ChannelFabric:
             self.trackers.append(step_trackers)
 
             step_rings: List[List[Optional[BufferRing]]] = []
-            shapes = None
-            if not is_final:
-                model_class = load_class(step.model)
-                shapes = model_class.output_shape()
-                if shapes is not None:
-                    shapes = get_segmented_shapes(tuple(map(tuple, shapes)),
-                                                  step.num_segments)
+            model_class = load_class(step.model) if not is_final else None
             num_slots = (step.num_shared_tensors
                          if step.num_shared_tensors is not None
                          else DEFAULT_NUM_SHARED_TENSORS)
-            for group in step.groups:
+            for group_idx, group in enumerate(step.groups):
+                shapes = None
+                if model_class is not None:
+                    shapes = model_class.output_shape_for(
+                        **step.kwargs_for_group(group_idx))
+                    if shapes is not None:
+                        shapes = get_segmented_shapes(
+                            tuple(map(tuple, shapes)), step.num_segments)
                 group_rings: List[Optional[BufferRing]] = []
                 for device in group.devices:
                     if shapes is None:

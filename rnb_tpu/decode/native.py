@@ -160,17 +160,18 @@ class DecodePool:
         return ticket
 
     def wait(self, ticket: int, path: str = "<submitted>") -> None:
+        # claim the ticket atomically before touching the native side:
+        # rnb_pool_wait blocks forever on unknown/retired tickets, and a
+        # check-then-act race between two waiters would send the loser
+        # into exactly that hang — the loser must fail fast here instead
         with self._pending_lock:
-            if ticket not in self._pending:
-                # the native side blocks forever on unknown/retired
-                # tickets; fail fast here instead
+            buffers = self._pending.pop(ticket, None)
+            if buffers is None:
                 raise ValueError("unknown or already-waited ticket %r"
                                  % (ticket,))
-        try:
-            _check(self._lib.rnb_pool_wait(self._pool, ticket), path)
-        finally:
-            with self._pending_lock:
-                self._pending.pop(ticket, None)
+        # `buffers` pins (out, starts) until the native workers finish
+        _check(self._lib.rnb_pool_wait(self._pool, ticket), path)
+        del buffers
 
     def close(self) -> None:
         if self._pool:

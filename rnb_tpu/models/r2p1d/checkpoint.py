@@ -39,7 +39,8 @@ _ensure_lock = threading.Lock()
 
 def init_variables(seed: int = 0, start: int = 1, end: int = NUM_LAYERS,
                    num_classes: int = KINETICS_CLASSES,
-                   layer_sizes=None) -> Dict[str, Any]:
+                   layer_sizes=None,
+                   factored_shortcut: bool = False) -> Dict[str, Any]:
     """Seeded init of the [start..end] classifier's variables
     (params + batch_stats).
 
@@ -50,7 +51,9 @@ def init_variables(seed: int = 0, start: int = 1, end: int = NUM_LAYERS,
     import jax
     kwargs = {} if layer_sizes is None else {"layer_sizes": layer_sizes}
     model = R2Plus1DClassifier(start=start, end=end,
-                               num_classes=num_classes, **kwargs)
+                               num_classes=num_classes,
+                               factored_shortcut=factored_shortcut,
+                               **kwargs)
     channels = LAYER_INPUT_SHAPES[start][-1]
     dummy = np.zeros((1, 2, 14, 14, channels), dtype=np.float32)
     init = jax.jit(lambda key: model.init(key, dummy, train=False))
@@ -126,13 +129,36 @@ def load_for_range(start: int, end: int,
 def load_or_init(start: int, end: int,
                  num_classes: int = KINETICS_CLASSES,
                  layer_sizes=R18_LAYER_SIZES,
-                 path: Optional[str] = None) -> Dict[str, Any]:
-    """The one checkpoint policy every execution path shares: the
-    default architecture loads the shared (range-filtered) checkpoint;
-    any other architecture (tests, tiny dry runs) gets a fresh seeded
-    init."""
-    if (num_classes, tuple(layer_sizes)) == (KINETICS_CLASSES,
-                                             tuple(R18_LAYER_SIZES)):
-        return load_for_range(start, end, path)
+                 path: Optional[str] = None,
+                 factored_shortcut: bool = False) -> Dict[str, Any]:
+    """The one checkpoint policy every execution path shares:
+
+    * an explicit existing ``path`` wins for any architecture — that is
+      how partitioned stages of a non-default (tiny/test) model share
+      one set of weights, and how converted external checkpoints
+      (checkpoint_convert) are loaded;
+    * otherwise the default architecture loads the shared
+      (range-filtered, materialized-once) checkpoint;
+    * any other architecture gets a fresh seeded init.
+    """
+    if path is not None:
+        # an explicit path must exist: silently materializing a fresh
+        # seeded init at a mistyped path would run the benchmark on
+        # random weights while the user believes they loaded pretrained
+        # ones
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                "explicit ckpt_path %r does not exist; convert or save "
+                "a checkpoint there first (models/r2p1d/convert.py)"
+                % (path,))
+        return filter_layer_range(load_checkpoint(path), start, end)
+    # the shared materialized checkpoint is the default (plain-shortcut)
+    # architecture; a factored-shortcut model without an explicit
+    # converted checkpoint gets a fresh matching init instead
+    if not factored_shortcut and (
+            num_classes, tuple(layer_sizes)) == (KINETICS_CLASSES,
+                                                 tuple(R18_LAYER_SIZES)):
+        return load_for_range(start, end)
     return init_variables(start=start, end=end, num_classes=num_classes,
-                          layer_sizes=tuple(layer_sizes))
+                          layer_sizes=tuple(layer_sizes),
+                          factored_shortcut=factored_shortcut)

@@ -1,0 +1,231 @@
+"""Torch -> Flax checkpoint conversion for R(2+1)D-18.
+
+The reference loaded a pretrained Kinetics-400 torch checkpoint
+(``model_data.pth.tar`` with a ``state_dict`` payload, reference
+models/r2p1d/model.py:18,50-63) whose module tree comes from the
+R2Plus1D-PyTorch submodule (``res2plus1d.conv{1..5}`` +
+``linear``). This module converts that state dict into this
+framework's Flax variable tree so the same pretrained weights drive
+the TPU pipeline:
+
+* torch ``Conv3d`` weights ``(out, in, T, H, W)`` transpose to Flax
+  ``(T, H, W, in, out)`` kernels;
+* torch ``BatchNorm3d`` splits into Flax params (weight->scale,
+  bias->bias) and batch_stats (running_mean->mean, running_var->var);
+* torch ``Linear`` ``(out, in)`` transposes to Dense ``(in, out)``;
+* module paths remap: ``convL.block1`` -> ``convL/block0``,
+  ``convL.blocks.{i}`` -> ``convL/block{i+1}``,
+  ``downsampleconv/downsamplebn`` -> ``shortcut/shortcut_bn``,
+  ``spatial_conv/temporal_conv`` -> ``spatial/temporal``;
+* the stem BN this network adds after conv1 (the torch stem conv is
+  bare) has no torch source and is initialized to identity, which is a
+  no-op in inference mode;
+* the torch downsampling shortcut is a *factored* 1x1x1 (2+1)D pair,
+  so converted trees target ``factored_shortcut=True`` models
+  (rnb_tpu.models.r2p1d.network.SpatioTemporalResBlock).
+
+Conversion is pure numpy — torch is only needed by :func:`convert_file`
+to unpickle a real ``.pth.tar``. Every converted tree is validated
+module-by-module against the target architecture's abstract init
+(structure AND shapes), so a truncated or mismatched state dict fails
+loudly instead of producing a silently wrong model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES, NUM_LAYERS,
+                                          R18_LAYER_SIZES)
+
+
+class ConversionError(ValueError):
+    """State dict does not match the expected reference format."""
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """torch Conv3d (out, in, T, H, W) -> Flax (T, H, W, in, out)."""
+    if w.ndim != 5:
+        raise ConversionError("conv weight must be 5-D, got %r"
+                              % (w.shape,))
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 4, 1, 0)))
+
+
+def _set(tree: Dict[str, Any], path: Sequence[str],
+         value: np.ndarray) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    if path[-1] in node:
+        raise ConversionError("duplicate assignment at %s"
+                              % "/".join(path))
+    node[path[-1]] = value
+
+
+def _bn(params: Dict[str, Any], stats: Dict[str, Any],
+        flax_path: Tuple[str, ...], torch_name: str,
+        sd: Mapping[str, np.ndarray], prefix: str) -> None:
+    """Map one BatchNorm3d: affine params + running statistics."""
+    for torch_key, target, leaf in (
+            ("weight", params, "scale"), ("bias", params, "bias")):
+        _set(target, flax_path + (leaf,),
+             _np(sd, "%s%s.%s" % (prefix, torch_name, torch_key), 1))
+    for torch_key, leaf in (("running_mean", "mean"),
+                            ("running_var", "var")):
+        _set(stats, flax_path + (leaf,),
+             _np(sd, "%s%s.%s" % (prefix, torch_name, torch_key), 1))
+
+
+def _np(sd: Mapping[str, Any], key: str, ndim: Optional[int] = None
+        ) -> np.ndarray:
+    if key not in sd:
+        raise ConversionError("state dict is missing %r" % key)
+    arr = np.asarray(sd[key], dtype=np.float32)
+    if ndim is not None and arr.ndim != ndim:
+        raise ConversionError("%r has %d dims, expected %d"
+                              % (key, arr.ndim, ndim))
+    return arr
+
+
+def _st_conv(params: Dict[str, Any], stats: Dict[str, Any],
+             flax_path: Tuple[str, ...], sd: Mapping[str, Any],
+             prefix: str) -> None:
+    """Map one SpatioTemporalConv (spatial conv + mid BN + temporal)."""
+    _set(params, flax_path + ("spatial", "kernel"),
+         _conv_kernel(_np(sd, prefix + "spatial_conv.weight")))
+    _bn(params, stats, flax_path + ("bn",), "bn", sd, prefix)
+    _set(params, flax_path + ("temporal", "kernel"),
+         _conv_kernel(_np(sd, prefix + "temporal_conv.weight")))
+
+
+def _identity_bn(params: Dict[str, Any], stats: Dict[str, Any],
+                 flax_path: Tuple[str, ...], features: int) -> None:
+    _set(params, flax_path + ("scale",), np.ones(features, np.float32))
+    _set(params, flax_path + ("bias",), np.zeros(features, np.float32))
+    _set(stats, flax_path + ("mean",), np.zeros(features, np.float32))
+    _set(stats, flax_path + ("var",), np.ones(features, np.float32))
+
+
+def convert_state_dict(state_dict: Mapping[str, Any],
+                       num_classes: int = KINETICS_CLASSES,
+                       layer_sizes: Sequence[int] = R18_LAYER_SIZES,
+                       validate: bool = True) -> Dict[str, Any]:
+    """Reference torch state dict -> full-model Flax variable tree.
+
+    The result loads into ``R2Plus1DClassifier(factored_shortcut=True)``
+    (and, range-filtered via checkpoint.filter_layer_range, into every
+    partitioned stage). With ``validate`` the tree is checked leaf by
+    leaf against the architecture's abstract init shapes.
+    """
+    sd = state_dict
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+
+    # stem: torch applies the factored conv bare; our trailing stem BN
+    # has no source weights and starts as the identity
+    _st_conv(params, stats, ("net", "conv1"), sd, "res2plus1d.conv1.")
+    _identity_bn(params, stats, ("net", "stem_bn"), 64)
+
+    for layer in range(2, NUM_LAYERS + 1):
+        blocks = int(layer_sizes[layer - 2])
+        downsample = layer >= 3
+        lname = "conv%d" % layer
+        for block in range(blocks):
+            # torch names the first block `block1` and the rest
+            # `blocks.{i}`; we name them block0..block{n-1}
+            tprefix = ("res2plus1d.%s.block1." % lname if block == 0
+                       else "res2plus1d.%s.blocks.%d." % (lname, block - 1))
+            fpath = ("net", lname, "block%d" % block)
+            _st_conv(params, stats, fpath + ("conv1",), sd,
+                     tprefix + "conv1.")
+            _bn(params, stats, fpath + ("bn1",), "bn1", sd, tprefix)
+            _st_conv(params, stats, fpath + ("conv2",), sd,
+                     tprefix + "conv2.")
+            _bn(params, stats, fpath + ("bn2",), "bn2", sd, tprefix)
+            if block == 0 and downsample:
+                _st_conv(params, stats, fpath + ("shortcut",), sd,
+                         tprefix + "downsampleconv.")
+                _bn(params, stats, fpath + ("shortcut_bn",),
+                    "downsamplebn", sd, tprefix)
+
+    _set(params, ("linear", "kernel"),
+         np.ascontiguousarray(_np(sd, "linear.weight", 2).T))
+    _set(params, ("linear", "bias"), _np(sd, "linear.bias", 1))
+
+    variables = {"params": params, "batch_stats": stats}
+    if validate:
+        validate_variables(variables, num_classes=num_classes,
+                           layer_sizes=layer_sizes)
+    return variables
+
+
+def validate_variables(variables: Dict[str, Any],
+                       num_classes: int = KINETICS_CLASSES,
+                       layer_sizes: Sequence[int] = R18_LAYER_SIZES
+                       ) -> None:
+    """Check a converted tree against the target architecture: same
+    leaf paths, same shapes (abstract init — no real compute)."""
+    import jax
+
+    from rnb_tpu.models.r2p1d.network import R2Plus1DClassifier
+
+    model = R2Plus1DClassifier(num_classes=num_classes,
+                               layer_sizes=tuple(layer_sizes),
+                               factored_shortcut=True)
+    x = jax.ShapeDtypeStruct((1, 2, 14, 14, 3), np.float32)
+    want = jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False), jax.random.key(0), x)
+
+    want_leaves = {
+        "/".join(str(k.key) for k in path): leaf.shape
+        for path, leaf in jax.tree_util.tree_flatten_with_path(want)[0]}
+    got_leaves = {
+        "/".join(str(k.key) for k in path): np.shape(leaf)
+        for path, leaf in
+        jax.tree_util.tree_flatten_with_path(variables)[0]}
+
+    missing = sorted(set(want_leaves) - set(got_leaves))
+    extra = sorted(set(got_leaves) - set(want_leaves))
+    if missing or extra:
+        raise ConversionError(
+            "converted tree structure mismatch: missing %s, unexpected %s"
+            % (missing[:5], extra[:5]))
+    for key, want_shape in want_leaves.items():
+        if tuple(got_leaves[key]) != tuple(want_shape):
+            raise ConversionError(
+                "converted %s has shape %r, architecture wants %r"
+                % (key, tuple(got_leaves[key]), tuple(want_shape)))
+
+
+def convert_file(pth_path: str, out_path: str,
+                 num_classes: int = KINETICS_CLASSES,
+                 layer_sizes: Sequence[int] = R18_LAYER_SIZES) -> str:
+    """Unpickle a reference ``.pth.tar`` (torch required), convert and
+    save as this framework's msgpack checkpoint. Returns ``out_path``."""
+    import torch
+
+    from rnb_tpu.models.r2p1d import checkpoint as ckpt
+
+    payload = torch.load(pth_path, map_location="cpu",
+                         weights_only=False)
+    state_dict = payload.get("state_dict", payload)
+    state_dict = {k: v.detach().cpu().numpy() if hasattr(v, "detach")
+                  else v for k, v in state_dict.items()}
+    variables = convert_state_dict(state_dict, num_classes=num_classes,
+                                   layer_sizes=layer_sizes)
+    ckpt.save_checkpoint(out_path, variables)
+    return out_path
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Convert a reference R(2+1)D torch checkpoint to "
+                    "the rnb_tpu msgpack format")
+    parser.add_argument("pth_path")
+    parser.add_argument("out_path")
+    args = parser.parse_args()
+    print(convert_file(args.pth_path, args.out_path))

@@ -67,16 +67,17 @@ def _normalize_row_buckets(row_buckets, max_rows: int, what: str):
 
 
 def _shared_apply(start: int, end: int, num_classes: int,
-                  layer_sizes: tuple):
+                  layer_sizes: tuple, factored_shortcut: bool = False):
     """One jitted inference applier shared by every replica of a range."""
-    key = (start, end, num_classes, layer_sizes)
+    key = (start, end, num_classes, layer_sizes, factored_shortcut)
     with _cache_lock:
         fn = _apply_cache.get(key)
         if fn is None:
             import jax
             model = R2Plus1DClassifier(start=start, end=end,
                                        num_classes=num_classes,
-                                       layer_sizes=layer_sizes)
+                                       layer_sizes=layer_sizes,
+                                       factored_shortcut=factored_shortcut)
 
             def apply(variables, x):
                 return model.apply(variables, x, train=False)
@@ -87,15 +88,18 @@ def _shared_apply(start: int, end: int, num_classes: int,
 
 
 def _shared_params(start: int, end: int, num_classes: int,
-                   layer_sizes: tuple, ckpt_path: Optional[str], device):
+                   layer_sizes: tuple, ckpt_path: Optional[str], device,
+                   factored_shortcut: bool = False):
     """Device-resident filtered weights, one copy per (range, device)."""
     import jax
-    key = (start, end, num_classes, layer_sizes, ckpt_path, id(device))
+    key = (start, end, num_classes, layer_sizes, ckpt_path, id(device),
+           factored_shortcut)
     with _cache_lock:
         params = _params_cache.get(key)
         if params is None:
-            variables = ckpt.load_or_init(start, end, num_classes,
-                                          layer_sizes, ckpt_path)
+            variables = ckpt.load_or_init(
+                start, end, num_classes, layer_sizes, ckpt_path,
+                factored_shortcut=factored_shortcut)
             params = jax.device_put(variables, device)
             _params_cache[key] = params
         return params
@@ -192,6 +196,13 @@ class R2P1DLoader(StageModel):
     def output_shape():
         return ((MAX_CLIPS, CONSECUTIVE_FRAMES, FRAME_HW, FRAME_HW, 3),)
 
+    @classmethod
+    def output_shape_for(cls, max_clips: int = MAX_CLIPS,
+                         consecutive_frames: int = CONSECUTIVE_FRAMES,
+                         **_kwargs):
+        return ((int(max_clips), int(consecutive_frames),
+                 FRAME_HW, FRAME_HW, 3),)
+
     def __call__(self, tensors, non_tensors, time_card):
         import jax
         video = str(non_tensors)
@@ -233,7 +244,8 @@ class R2P1DRunner(StageModel):
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
                  num_warmups: int = NUM_WARMUPS,
                  ckpt_path: Optional[str] = None,
-                 row_buckets=None, **kwargs):
+                 row_buckets=None, factored_shortcut: bool = False,
+                 **kwargs):
         super().__init__(device)
         import jax
         if not (1 <= start_index <= end_index <= NUM_LAYERS):
@@ -244,17 +256,26 @@ class R2P1DRunner(StageModel):
         self.max_rows = int(max_rows)
         layer_sizes = tuple(layer_sizes)
         self._jax_device = _resolve(device)
+        # factored_shortcut matches converted reference checkpoints
+        # (models/r2p1d/convert.py); default is the plain projection
         self._apply = _shared_apply(self.start_index, self.end_index,
-                                    num_classes, layer_sizes)
+                                    num_classes, layer_sizes,
+                                    bool(factored_shortcut))
         self._variables = _shared_params(self.start_index, self.end_index,
                                          num_classes, layer_sizes,
-                                         ckpt_path, self._jax_device)
-        # warm-up on the exact steady-state shape; the temporal extent
-        # follows the pipeline's consecutive_frames when this stage sits
-        # at layer 1
-        shape = list(LAYER_INPUT_SHAPES[self.start_index])
+                                         ckpt_path, self._jax_device,
+                                         bool(factored_shortcut))
+        # warm-up on the exact steady-state shape. The temporal extent
+        # follows the pipeline's consecutive_frames everywhere: at layer
+        # 1 it IS consecutive_frames; mid-pipeline it is whatever the
+        # upstream range [1..start-1] downsampled those frames to (the
+        # static LAYER_INPUT_SHAPES table only covers the default 8)
+        from rnb_tpu.models.r2p1d.network import range_output_shape
         if self.start_index == 1:
-            shape[0] = int(consecutive_frames)
+            shape = (int(consecutive_frames),) + LAYER_INPUT_SHAPES[1][1:]
+        else:
+            shape = range_output_shape(1, self.start_index - 1,
+                                       int(consecutive_frames))
         self._steady_shape = (self.max_rows,) + tuple(shape)
         # warm up with the dtype the pipeline actually flows: the
         # loader's preprocess emits bfloat16 into layer 1, while an
@@ -280,13 +301,27 @@ class R2P1DRunner(StageModel):
 
     @staticmethod
     def output_shape():
-        # full-range logits; a partial-range (end<5) mid-pipeline split
-        # needs a custom stage class declaring its feature-map shape —
-        # same restriction the reference documents (its hardcoded
-        # (10,400) is wrong for partial ranges, see its TODO #69 note at
-        # models/r2p1d/model.py:76-80; ours is at least correct for the
-        # shipped topologies)
+        # full-range default; partial ranges declare their exact
+        # feature-map shape via output_shape_for below
         return ((MAX_CLIPS, KINETICS_CLASSES),)
+
+    @classmethod
+    def output_shape_for(cls, start_index: int = 1,
+                         end_index: int = NUM_LAYERS,
+                         num_classes: int = KINETICS_CLASSES,
+                         max_rows: int = MAX_CLIPS,
+                         consecutive_frames: int = CONSECUTIVE_FRAMES,
+                         **_kwargs):
+        # exact per-range shape — fixes the restriction the reference
+        # shipped broken (hardcoded (10, 400) for every range, its TODO
+        # #69 at models/r2p1d/model.py:76-80): a conv1-4 stage declares
+        # its feature map, so the runtime can size rings for a
+        # mid-pipeline layer split
+        from rnb_tpu.models.r2p1d.network import range_output_shape
+        per_row = range_output_shape(int(start_index), int(end_index),
+                                     int(consecutive_frames),
+                                     int(num_classes))
+        return ((int(max_rows),) + per_row,)
 
     def __call__(self, tensors, non_tensors, time_card):
         import jax
@@ -311,13 +346,19 @@ class R2P1DSingleStep(StageModel):
         self.loader = R2P1DLoader(device, max_clips=max_clips,
                                   consecutive_frames=consecutive_frames,
                                   num_warmups=num_warmups, **kwargs)
+        # the inner runner must warm the same bucket shapes the loader
+        # emits, or the first occurrence of each bucket would pay a
+        # silent XLA recompile inside the measured window
         self.net = R2P1DRunner(device, start_index=1, end_index=NUM_LAYERS,
                                num_classes=num_classes,
                                layer_sizes=layer_sizes,
                                max_rows=max_clips,
                                consecutive_frames=consecutive_frames,
                                num_warmups=num_warmups,
-                               ckpt_path=ckpt_path)
+                               ckpt_path=ckpt_path,
+                               row_buckets=kwargs.get("row_buckets"),
+                               factored_shortcut=kwargs.get(
+                                   "factored_shortcut", False))
 
     def input_shape(self):
         return None
@@ -350,9 +391,11 @@ class R2P1DMeshRunner(StageModel):
     Config: home the stage on one device (its executor thread) and pass
     ``mesh_devices`` = the logical device indices forming the sub-mesh
     (the home device should be among them). ``sp`` = len(mesh_devices)
-    must divide ``max_clips``. Consumes the loader's ``raw_output``
-    uint8 batches and emits the predicted class id (final-stage
-    contract, no tensor outputs).
+    need not divide ``max_clips`` — the sharded step pads the clip axis
+    to the next multiple inside the compiled program (masked rows), so
+    e.g. 8 cores serve 15-clip batches with none idle. Consumes the
+    loader's ``raw_output`` uint8 batches and emits the predicted class
+    id (final-stage contract, no tensor outputs).
     """
 
     def __init__(self, device, mesh_devices,
@@ -361,7 +404,8 @@ class R2P1DMeshRunner(StageModel):
                  num_classes: int = KINETICS_CLASSES,
                  layer_sizes=R18_LAYER_SIZES,
                  num_warmups: int = NUM_WARMUPS,
-                 ckpt_path: Optional[str] = None, **kwargs):
+                 ckpt_path: Optional[str] = None,
+                 factored_shortcut: bool = False, **kwargs):
         super().__init__(device)
         import numpy as _np
         import jax
@@ -378,7 +422,7 @@ class R2P1DMeshRunner(StageModel):
             mesh, max_clips=self.max_clips,
             consecutive_frames=self.consecutive_frames,
             num_classes=num_classes, layer_sizes=tuple(layer_sizes),
-            ckpt_path=ckpt_path)
+            ckpt_path=ckpt_path, factored_shortcut=factored_shortcut)
         dummy = np.zeros(self._si.batch_shape(1), np.uint8)
         for _ in range(num_warmups):
             vids, mask = self._si.place(dummy, [self.max_clips])

@@ -51,6 +51,37 @@ LAYER_INPUT_SHAPES = {
 LAYER_FEATURES = {2: 64, 3: 128, 4: 256, 5: 512}
 
 
+def range_output_shape(start: int, end: int,
+                       consecutive_frames: int = 8,
+                       num_classes: int = KINETICS_CLASSES
+                       ) -> Tuple[int, ...]:
+    """Per-row output shape of the layer range [start..end].
+
+    Walks the network's downsampling schedule: the stem halves H/W,
+    layers 3-5 halve T/H/W (stride-2 convs with SAME-style padding, so
+    odd extents round up). A range reaching layer 5 pools + classifies
+    to ``(num_classes,)``. This is the exact shape the runtime needs to
+    size buffer rings for a mid-pipeline layer split — the reference
+    hardcoded full-range logits and documented the partial-range case
+    as broken (its TODO #69, models/r2p1d/model.py:76-80).
+    """
+    if not (1 <= start <= end <= NUM_LAYERS):
+        raise ValueError("invalid layer range [%s..%s]" % (start, end))
+    t, h, w, c = LAYER_INPUT_SHAPES[start]
+    if start == 1:
+        t = int(consecutive_frames)
+    for layer in range(start, end + 1):
+        if layer == 1:
+            h, w, c = -(-h // 2), -(-w // 2), 64
+        else:
+            c = LAYER_FEATURES[layer]
+            if layer >= 3:
+                t, h, w = -(-t // 2), -(-h // 2), -(-w // 2)
+    if end == NUM_LAYERS:
+        return (int(num_classes),)
+    return (t, h, w, c)
+
+
 def normalize_u8(x, dtype=jnp.bfloat16):
     """uint8 [0,255] frames -> ``dtype`` in [-1, 1] — the one
     normalization every ingest path (pipeline loader preprocess,
@@ -103,10 +134,19 @@ class SpatioTemporalConv(nn.Module):
 
 
 class SpatioTemporalResBlock(nn.Module):
-    """Pre-shortcut residual block of two (2+1)D convs."""
+    """Pre-shortcut residual block of two (2+1)D convs.
+
+    ``factored_shortcut`` reproduces the reference submodule's
+    downsampling shortcut exactly — a *factored* 1x1x1 (2+1)D pair with
+    BN+ReLU in the middle — so checkpoints converted from the
+    reference's torch format (checkpoint_convert) load with bit-exact
+    structure. Off by default: the plain strided projection is the
+    standard ResNet choice and avoids an unmotivated bottleneck.
+    """
 
     features: int
     downsample: bool = False
+    factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -124,9 +164,14 @@ class SpatioTemporalResBlock(nn.Module):
                            name="bn2")(res)
 
         if self.downsample:
-            x = nn.Conv(self.features, kernel_size=(1, 1, 1),
-                        strides=(2, 2, 2), use_bias=False, dtype=self.dtype,
-                        name="shortcut")(x)
+            if self.factored_shortcut:
+                x = SpatioTemporalConv(self.features, kernel=(1, 1),
+                                       stride=(2, 2), dtype=self.dtype,
+                                       name="shortcut")(x, train)
+            else:
+                x = nn.Conv(self.features, kernel_size=(1, 1, 1),
+                            strides=(2, 2, 2), use_bias=False,
+                            dtype=self.dtype, name="shortcut")(x)
             x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                              name="shortcut_bn")(x)
         return nn.relu(x + res)
@@ -138,12 +183,14 @@ class SpatioTemporalResLayer(nn.Module):
     features: int
     num_blocks: int
     downsample: bool = False
+    factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = SpatioTemporalResBlock(self.features,
                                    downsample=self.downsample,
+                                   factored_shortcut=self.factored_shortcut,
                                    dtype=self.dtype, name="block0")(x, train)
         for i in range(1, self.num_blocks):
             x = SpatioTemporalResBlock(self.features, dtype=self.dtype,
@@ -165,6 +212,7 @@ class R2Plus1DNet(nn.Module):
     start: int = 1
     end: int = NUM_LAYERS
     layer_sizes: Sequence[int] = R18_LAYER_SIZES
+    factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -188,6 +236,7 @@ class R2Plus1DNet(nn.Module):
                     LAYER_FEATURES[layer],
                     num_blocks=self.layer_sizes[layer - 2],
                     downsample=(layer >= 3),
+                    factored_shortcut=self.factored_shortcut,
                     dtype=self.dtype,
                     name="conv%d" % layer)(x, train)
         if self.end == NUM_LAYERS:
@@ -207,12 +256,15 @@ class R2Plus1DClassifier(nn.Module):
     end: int = NUM_LAYERS
     num_classes: int = KINETICS_CLASSES
     layer_sizes: Sequence[int] = R18_LAYER_SIZES
+    factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = R2Plus1DNet(start=self.start, end=self.end,
-                        layer_sizes=self.layer_sizes, dtype=self.dtype,
+                        layer_sizes=self.layer_sizes,
+                        factored_shortcut=self.factored_shortcut,
+                        dtype=self.dtype,
                         name="net")(x, train)
         if self.end == NUM_LAYERS:
             x = nn.Dense(self.num_classes, dtype=self.dtype,

@@ -43,8 +43,15 @@ class ShardedInference:
     aggregated logits ``(videos, num_classes)`` — already summed over
     each video's valid clips and psum-reduced across the ``sp`` axis.
 
-    The mesh's ``dp`` size must divide the video axis and its ``sp``
-    size must divide ``max_clips`` (fixed shapes; pad with masked rows).
+    The mesh's ``dp`` size must divide the video axis. The clip axis
+    needs no divisibility: when ``sp`` does not divide ``max_clips`` the
+    step pads the clip axis up to the next multiple *inside* the
+    compiled program — the padded rows carry a zero mask, so they cost
+    one slice of dead MXU work and change no result. That is what lets
+    e.g. ``sp=8`` serve ``max_clips=15`` (15 -> 16) and use every core
+    of an 8-device mesh instead of idling three (the reference's
+    segment parallelism had the same constraint and simply required
+    divisibility).
     """
 
     def __init__(self, mesh, max_clips: int = 15,
@@ -55,7 +62,8 @@ class ShardedInference:
                  dtype: Any = None,
                  ckpt_path: Optional[str] = None,
                  dp_axis: str = "dp", sp_axis: str = "sp",
-                 variables: Optional[Any] = None):
+                 variables: Optional[Any] = None,
+                 factored_shortcut: bool = False):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -74,23 +82,34 @@ class ShardedInference:
         layer_sizes = tuple(layer_sizes)
 
         sp_size = mesh.shape[sp_axis]
-        if self.max_clips % sp_size != 0:
-            raise ValueError(
-                "the sp axis size (%d) must divide max_clips=%d; pad the "
-                "clip axis up to a multiple (masked rows are free)"
-                % (sp_size, self.max_clips))
+        self.sp_size = sp_size
+        #: internal clip-axis extent: max_clips rounded up to a multiple
+        #: of sp so every sp member gets an equal shard
+        self.padded_clips = -(-self.max_clips // sp_size) * sp_size
 
         model = R2Plus1DClassifier(start=1, end=NUM_LAYERS,
                                    num_classes=num_classes,
-                                   layer_sizes=layer_sizes, dtype=dtype)
+                                   layer_sizes=layer_sizes, dtype=dtype,
+                                   factored_shortcut=factored_shortcut)
 
         if variables is None:
-            variables = ckpt.load_or_init(1, NUM_LAYERS, num_classes,
-                                          layer_sizes, ckpt_path)
+            variables = ckpt.load_or_init(
+                1, NUM_LAYERS, num_classes, layer_sizes, ckpt_path,
+                factored_shortcut=factored_shortcut)
         replicated = NamedSharding(mesh, P())
         self.variables = jax.device_put(variables, replicated)
 
-        self.batch_sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
+        clip_pad = self.padded_clips - self.max_clips
+        # External arrays always carry max_clips clip rows. With no
+        # padding the clip axis is sharded straight over sp (each core
+        # receives only its shard on transfer); with padding the input
+        # arrives dp-sharded/sp-replicated and the jitted step pads +
+        # slices it — the broadcast is the price of using every core
+        # when sp does not divide max_clips.
+        if clip_pad == 0:
+            self.batch_sharding = NamedSharding(mesh, P(dp_axis, sp_axis))
+        else:
+            self.batch_sharding = NamedSharding(mesh, P(dp_axis))
         self.logit_sharding = NamedSharding(mesh, P(dp_axis))
 
         try:
@@ -112,7 +131,15 @@ class ShardedInference:
             step, mesh=mesh,
             in_specs=(P(), P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
             out_specs=P(dp_axis))
-        self._run = jax.jit(sharded)
+        if clip_pad == 0:
+            self._run = jax.jit(sharded)
+        else:
+            def padded(variables, vids, mask):
+                vids = jnp.pad(
+                    vids, ((0, 0), (0, clip_pad)) + ((0, 0),) * 4)
+                mask = jnp.pad(mask, ((0, 0), (0, clip_pad)))
+                return sharded(variables, vids, mask)
+            self._run = jax.jit(padded)
 
     def batch_shape(self, num_videos: int) -> Tuple[int, ...]:
         return (num_videos, self.max_clips, self.consecutive_frames,

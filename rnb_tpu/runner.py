@@ -118,13 +118,44 @@ def _block_on(payload) -> None:
     jax.block_until_ready([pb.data for pb in payload])
 
 
+def validate_payload(declared, payload, where: str) -> None:
+    """Assert a stage's produced payload matches its declared
+    ``output_shape_for``: same tensor count, same trailing dims, row
+    axis no larger than the declared max (smaller is legal under row
+    bucketing). Keeps shape metadata honest — a declaration nothing
+    checks is dead metadata that silently rots.
+    """
+    payload = tuple(payload) if payload else ()
+    if declared is None:
+        if payload:
+            raise ValueError(
+                "%s declares no tensor outputs (output_shape None) but "
+                "produced %d tensor(s)" % (where, len(payload)))
+        return
+    declared = tuple(map(tuple, declared))
+    if len(payload) != len(declared):
+        raise ValueError(
+            "%s declares %d output tensor(s) %r but produced %d"
+            % (where, len(declared), declared, len(payload)))
+    for idx, (pb, want) in enumerate(zip(payload, declared)):
+        got = tuple(int(d) for d in pb.data.shape)
+        if (len(got) != len(want) or got[1:] != want[1:]
+                or got[0] > want[0]):
+            raise ValueError(
+                "%s output %d has shape %r but declares %r (row axis may "
+                "be smaller under bucketing, never larger; trailing dims "
+                "must match exactly)" % (where, idx, got, want))
+
+
 def runner(ctx: RunnerContext) -> None:
     """Thread entry: init the stage, run the hot loop, drain cleanly."""
     summary = TimeCardSummary() if ctx.out_queues is None else None
     progress_bar = None
+    declared_shapes = None
     try:
         model_class = load_class(ctx.model_class_path)
         model = model_class(ctx.device, **ctx.model_kwargs)
+        declared_shapes = model_class.output_shape_for(**ctx.model_kwargs)
 
         selector = None
         if ctx.out_queues is not None:
@@ -185,6 +216,9 @@ def runner(ctx: RunnerContext) -> None:
                     # stage swallowed the item (accumulating batcher /
                     # aggregator) — nothing moves downstream
                     continue
+                validate_payload(declared_shapes, tensors_out,
+                                 "step %d %s" % (ctx.step_idx,
+                                                 ctx.model_class_path))
                 if ctx.sync_outputs and tensors_out:
                     _block_on(tensors_out)
                 time_card.record("inference%d_finish" % ctx.step_idx)

@@ -78,6 +78,15 @@ class StageModel:
       tensors, or None meaning "this stage emits no tensors", in which
       case the runtime allocates no device ring for it (reference
       runner_model.py:31-46 — note None differs from ``()``).
+    * ``output_shape_for(**model_kwargs)`` — classmethod refinement of
+      ``output_shape()``: receives the step's model kwargs (the same
+      dict the constructor gets) so config-dependent stages — a partial
+      layer range, a non-default row count — can declare their *exact*
+      output shapes. The runtime sizes buffer rings with this and
+      validates every produced payload against it, so shape metadata
+      can never silently rot (the reference's hardcoded (10, 400) was
+      wrong for partial ranges — its TODO #69,
+      models/r2p1d/model.py:76-80). Default: the static shape.
     * ``__call__(tensors, non_tensors, time_card)`` — run one request.
       ``tensors`` is a tuple of :class:`PaddedBatch` (or None for the
       first stage); returns ``(tensors, non_tensors, time_card)`` where a
@@ -95,6 +104,17 @@ class StageModel:
     @staticmethod
     def output_shape() -> Optional[Tuple[Tuple[int, ...], ...]]:
         return None
+
+    @classmethod
+    def output_shape_for(cls, **model_kwargs) -> Optional[
+            Tuple[Tuple[int, ...], ...]]:
+        """Config-aware output shapes; defaults to ``output_shape()``.
+
+        Overrides must accept (and ignore) arbitrary kwargs — the
+        runtime passes the step's full model-kwargs dict.
+        """
+        del model_kwargs
+        return cls.output_shape()
 
     def __call__(self, tensors, non_tensors, time_card):
         raise NotImplementedError

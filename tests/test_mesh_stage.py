@@ -85,10 +85,34 @@ def test_mesh_pipeline_end_to_end(tmp_path):
     assert len(reports) == 1
 
 
-def test_mesh_runner_rejects_indivisible_sp():
+def test_mesh_stage_pads_indivisible_clip_axis():
+    """sp=3 does not divide max_clips=2: the step pads the clip axis to
+    3 inside the compiled program (masked rows), so every mesh core is
+    used and predictions still match a plain single-device forward —
+    this is what lets an 8-core mesh serve the 15-clip flagship."""
     import jax
     from rnb_tpu.models.r2p1d.model import R2P1DMeshRunner
-    with pytest.raises(ValueError):
-        R2P1DMeshRunner(device=jax.devices()[0],
-                        mesh_devices=[0, 1, 2],  # 3 does not divide 2
-                        **TINY)
+    from rnb_tpu.models.r2p1d import checkpoint as ckpt
+    from rnb_tpu.models.r2p1d.network import (R2Plus1DClassifier,
+                                              normalize_u8)
+    from rnb_tpu.stage import PaddedBatch
+    from rnb_tpu.telemetry import TimeCard
+
+    stage = R2P1DMeshRunner(device=jax.devices()[0],
+                            mesh_devices=[0, 1, 2], **TINY)
+    assert stage._si.padded_clips == 3
+    rng = np.random.default_rng(7)
+    clips = rng.integers(
+        0, 256, (TINY["max_clips"], TINY["consecutive_frames"], 112, 112,
+                 3), dtype=np.uint8)
+    model = R2Plus1DClassifier(num_classes=TINY["num_classes"],
+                               layer_sizes=tuple(TINY["layer_sizes"]))
+    variables = ckpt.load_or_init(
+        1, 5, TINY["num_classes"], tuple(TINY["layer_sizes"]))
+    for valid in (1, 2):
+        pb = PaddedBatch(jax.numpy.asarray(clips), valid)
+        _, pred, _ = stage((pb,), None, TimeCard(0))
+        logits = model.apply(variables, normalize_u8(clips[:valid]),
+                             train=False)
+        want = int(np.asarray(logits, np.float32).sum(axis=0).argmax())
+        assert pred == want, "valid=%d" % valid

@@ -113,3 +113,30 @@ def test_train_mode_updates_batch_stats():
     old = jax.tree_util.tree_leaves(v["batch_stats"])
     new = jax.tree_util.tree_leaves(mutated["batch_stats"])
     assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_range_output_shape_matches_traced_shapes():
+    # the runtime sizes buffer rings for layer-split pipelines from
+    # range_output_shape — it must agree with the network's real output
+    # shapes for every contiguous range (abstract trace, no compile)
+    from rnb_tpu.models.r2p1d.network import range_output_shape
+    rows, frames, classes = 2, 8, 8
+    for start in range(1, 6):
+        for end in range(start, 6):
+            m = R2Plus1DClassifier(start=start, end=end,
+                                   num_classes=classes,
+                                   layer_sizes=(1, 1, 1, 1), dtype=DTYPE)
+            if start == 1:
+                per_row = (frames,) + LAYER_INPUT_SHAPES[1][1:]
+            else:
+                per_row = range_output_shape(1, start - 1, frames)
+            x = jax.ShapeDtypeStruct((rows,) + per_row, DTYPE)
+            variables = jax.eval_shape(
+                lambda k, x, m=m: m.init(k, x, train=False),
+                jax.random.key(0), x)
+            out = jax.eval_shape(
+                lambda v, x, m=m: m.apply(v, x, train=False),
+                variables, x)
+            want = (rows,) + range_output_shape(start, end, frames,
+                                                classes)
+            assert out.shape == want, (start, end, out.shape, want)

@@ -101,12 +101,29 @@ def test_sharded_inference_predict_deterministic():
     np.testing.assert_array_equal(p1, p2)
 
 
-def test_sharded_inference_rejects_bad_clip_split():
-    with pytest.raises(ValueError):
-        make_sharded_inference(mesh=build_mesh(axes={"dp": 4, "sp": 2}),
-                               max_clips=15, consecutive_frames=4,
-                               frame_hw=32, num_classes=16,
-                               layer_sizes=(1, 1, 1, 1))
+def test_sharded_inference_pads_indivisible_clip_axis():
+    # sp=2 does not divide max_clips=3: the step pads 3->4 inside the
+    # compiled program; results must match the divisible case run on
+    # the same clips (the padded row is masked out)
+    import jax
+    si_pad = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:4], axes={"dp": 2, "sp": 2}),
+        max_clips=3,
+        consecutive_frames=4, frame_hw=32, num_classes=16,
+        layer_sizes=(1, 1, 1, 1))
+    assert si_pad.padded_clips == 4
+    si_ref = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:2], axes={"dp": 2, "sp": 1}),
+        max_clips=3,
+        consecutive_frames=4, frame_hw=32, num_classes=16,
+        layer_sizes=(1, 1, 1, 1))
+    rng = np.random.default_rng(0)
+    videos = rng.integers(0, 256, si_pad.batch_shape(2), dtype=np.uint8)
+    valid = [3, 2]
+    pad_logits = np.asarray(si_pad.run(*si_pad.place(videos, valid)))
+    ref_logits = np.asarray(si_ref.run(*si_ref.place(videos, valid)))
+    np.testing.assert_allclose(pad_logits, ref_logits, rtol=0, atol=0.1)
+    assert pad_logits.shape == (2, 16)
 
 
 def test_distributed_single_process_mode(monkeypatch):

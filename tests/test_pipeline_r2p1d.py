@@ -46,3 +46,85 @@ def test_r2p1d_whole_pipeline(tmp_path):
     assert "inference0_finish" in header  # loader stage timed
     assert "inference1_finish" in header  # net stage timed
     assert len(lines) - 1 >= 4
+
+
+def test_r2p1d_layer_split_pipeline(tmp_path):
+    """Inter-layer partitioning end-to-end: loader -> conv1-4 -> conv5.
+
+    The mid-pipeline feature-map hand-off the reference could never wire
+    (its TODO #69: output shapes hardcoded to full-range logits); here
+    the conv1-4 stage declares its exact shape via output_shape_for and
+    the runtime sizes its ring from it.
+    """
+    tiny = {"num_classes": 8, "layer_sizes": [1, 1, 1, 1],
+            "consecutive_frames": 2, "num_warmups": 1}
+    cfg = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 8,
+             "max_clips": 2, "consecutive_frames": 2,
+             "num_clips_population": [1, 2], "weights": [3, 1],
+             "num_warmups": 1},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [1], "in_queue": 0,
+                               "out_queues": [0]}],
+             "start_index": 1, "end_index": 4, "max_rows": 2, **tiny},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [2], "in_queue": 0}],
+             "start_index": 5, "end_index": 5, "max_rows": 2, **tiny},
+        ],
+    }
+    path = os.path.join(str(tmp_path), "split.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    res = run_benchmark(path, mean_interval_ms=0, num_videos=4,
+                        queue_size=20, log_base=str(tmp_path / "logs"),
+                        print_progress=False)
+    assert res.termination_flag == TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    reports = [f for f in os.listdir(res.log_dir) if "group" in f]
+    with open(os.path.join(res.log_dir, reports[0])) as f:
+        header = f.readline().split()
+    assert "inference2_finish" in header  # all three stages timed
+
+
+def test_split_range_logits_match_whole_range(tmp_path):
+    """conv1-4 -> conv5 staged inference must reproduce the whole-range
+    logits when both load the same checkpoint (weight-sharing via
+    explicit ckpt_path, checkpoint.load_or_init)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rnb_tpu.models.r2p1d import checkpoint as ckpt
+    from rnb_tpu.models.r2p1d.model import R2P1DRunner
+    from rnb_tpu.stage import PaddedBatch
+    from rnb_tpu.telemetry import TimeCard
+
+    tiny = dict(num_classes=8, layer_sizes=(1, 1, 1, 1), max_rows=2,
+                consecutive_frames=2, num_warmups=1)
+    path = os.path.join(str(tmp_path), "tiny.msgpack")
+    ckpt.save_checkpoint(path, ckpt.init_variables(
+        seed=3, num_classes=8, layer_sizes=(1, 1, 1, 1)))
+
+    import jax
+    dev = jax.devices()[0]
+    stage_a = R2P1DRunner(dev, start_index=1, end_index=4,
+                          ckpt_path=path, **tiny)
+    stage_b = R2P1DRunner(dev, start_index=5, end_index=5,
+                          ckpt_path=path, **tiny)
+    whole = R2P1DRunner(dev, start_index=1, end_index=5,
+                        ckpt_path=path, **tiny)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 2, 112, 112, 3)),
+                    jnp.bfloat16)
+    pb = PaddedBatch(x, 2)
+    (feat,), _, tc = stage_a((pb,), None, TimeCard(0))
+    (split_logits,), _, tc = stage_b((feat,), None, tc)
+    (whole_logits,), _, _ = whole((pb,), None, TimeCard(1))
+    np.testing.assert_allclose(np.asarray(split_logits.data),
+                               np.asarray(whole_logits.data),
+                               rtol=0, atol=0.05)
+    assert split_logits.valid == whole_logits.valid == 2

@@ -74,7 +74,7 @@ def test_rnb_topology_routing_and_batching(tmp_path):
                  {"devices": [2], "in_queue": 0, "out_queues": [0],
                   "batch": 3},
                  {"devices": [3], "in_queue": 1, "out_queues": [0]}],
-             "num_shared_tensors": 10},
+             "num_shared_tensors": 10, "shapes": [[4, 2]]},
             {"model": "tests.pipeline_helpers.TinySink",
              "queue_groups": [{"devices": [-1], "in_queue": 0}]},
         ],

@@ -85,3 +85,33 @@ def test_load_class_roundtrip():
         load_class("NoDots")
     with pytest.raises(ImportError):
         load_class("rnb_tpu.selector.DoesNotExist")
+
+
+def test_validate_payload_contract():
+    import numpy as np
+    import pytest
+    from rnb_tpu.runner import validate_payload
+    from rnb_tpu.stage import PaddedBatch
+
+    declared = ((4, 2),)
+    ok = (PaddedBatch(np.zeros((4, 2), np.float32), 3),)
+    validate_payload(declared, ok, "step")
+    # smaller row axis is legal (row bucketing)
+    validate_payload(declared, (PaddedBatch(np.zeros((2, 2)), 1),), "step")
+    # trailing-dim mismatch: the exact rot the NCFHW batcher declaration
+    # had in round 1 — must be caught, not silently parked
+    with pytest.raises(ValueError):
+        validate_payload(declared, (PaddedBatch(np.zeros((4, 3)), 1),),
+                         "step")
+    # larger row axis than declared
+    with pytest.raises(ValueError):
+        validate_payload(declared, (PaddedBatch(np.zeros((5, 2)), 1),),
+                         "step")
+    # tensor-count mismatch
+    with pytest.raises(ValueError):
+        validate_payload(declared, ok * 2, "step")
+    # None declaration forbids tensor output; empty payload is fine
+    validate_payload(None, None, "step")
+    validate_payload(None, (), "step")
+    with pytest.raises(ValueError):
+        validate_payload(None, ok, "step")
