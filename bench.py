@@ -8,10 +8,16 @@ the same topology behind the reference's only published number
 
 Prints exactly ONE JSON line:
   {"metric": "videos_per_sec", "value": N, "unit": "videos/s",
-   "vs_baseline": N / 11.3}
+   "vs_baseline": N / 11.3, "platform": "tpu", "num_devices": 1,
+   "num_videos": 500, "config": "configs/r2p1d-whole.json"}
 and on unrecoverable failure a structured error line instead:
   {"metric": "videos_per_sec", "value": null, "unit": "videos/s",
    "vs_baseline": null, "error": "..."}
+
+``vs_baseline`` is only reported when the measured platform is the TPU
+plugin — the reference number is a GPU-hardware number and comparing a
+host-CPU run against it would be meaningless (and unauditable, since
+round-2 review noted nothing *asserted* what was measured).
 
 Backend resilience: the TPU in this environment is reached through a
 tunnel that can be transiently unavailable (and, when wedged, makes
@@ -64,30 +70,40 @@ print("%d:%s" % (len(devs), devs[0].platform))
 def _probe_backend(budget_s: float, attempt_timeout_s: float) -> str:
     """Wait (with backoff) until a fresh interpreter can init the
     default JAX backend. Returns '' on success, else an error string.
+    (The measured platform is reported from the live backend after the
+    run, not from the probe — the tunnel could re-resolve in between.)
 
     Each attempt is a subprocess so a failed/hung init never poisons
     this process's backend cache; the subprocess exits on its own
-    internal deadline — it is never killed externally.
+    internal deadline — it is never killed externally. If even the
+    internal watchdog fails (backend init holding the GIL so the daemon
+    thread never runs), the child is ABANDONED, not killed: a SIGKILL
+    on a TPU-attached process is exactly what wedges the tunnel. An
+    abandoned child self-exits if its watchdog ever gets scheduled, and
+    otherwise lingers harmlessly until the tunnel releases it.
     """
     start = time.monotonic()
     backoff, attempt, last = 15.0, 0, "no probe attempted"
+    abandoned = []
     while True:
         attempt += 1
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC, str(attempt_timeout_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         try:
-            proc = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC, str(attempt_timeout_s)],
-                capture_output=True, text=True,
-                # generous hard stop: the internal watchdog fires first;
-                # this outer guard only catches a watchdog failure
-                timeout=attempt_timeout_s + 60)
+            # generous soft stop: the internal watchdog fires first;
+            # reaching this timeout means the watchdog itself is stuck
+            out, errout = proc.communicate(timeout=attempt_timeout_s + 60)
         except subprocess.TimeoutExpired:
-            last = "probe watchdog failed; outer timeout hit"
+            abandoned.append(proc)  # never killed — see docstring
+            last = ("probe watchdog failed; child pid %d abandoned "
+                    "(not killed)" % proc.pid)
         else:
             if proc.returncode == 0:
                 sys.stderr.write("bench: backend up (%s) after %d probe(s)\n"
-                                 % (proc.stdout.strip(), attempt))
+                                 % (out.strip(), attempt))
                 return ""
-            tail = (proc.stderr or "").strip().splitlines()
+            tail = (errout or "").strip().splitlines()
             last = ("probe rc=%d: %s"
                     % (proc.returncode, tail[-1] if tail else "no output"))
         elapsed = time.monotonic() - start
@@ -99,14 +115,26 @@ def _probe_backend(budget_s: float, attempt_timeout_s: float) -> str:
         backoff = min(backoff * 2, 120.0)
 
 
+#: the real stdout, captured before any redirect_stdout so the one-line
+#: JSON contract holds even when the watchdog fires mid-redirect
+#: (round-2 advisor: the error line used to land in the discarded
+#: StringIO and the process exited with empty stdout).
+_REAL_STDOUT = sys.stdout
+
+
+def _emit(payload: dict) -> None:
+    _REAL_STDOUT.write(json.dumps(payload) + "\n")
+    _REAL_STDOUT.flush()
+
+
 def _emit_error(msg: str) -> int:
-    print(json.dumps({
+    _emit({
         "metric": "videos_per_sec",
         "value": None,
         "unit": "videos/s",
         "vs_baseline": None,
         "error": msg[:500],
-    }))
+    })
     return 1
 
 
@@ -172,13 +200,30 @@ def main() -> int:
         return _emit_error("%s: %s" % (type(e).__name__, e))
     done.set()
 
-    value = result.throughput_vps
-    print(json.dumps({
+    # record what was actually measured: the live backend, not the
+    # probe's claim (the tunnel could have re-resolved in between)
+    import jax
+    devs = jax.devices()
+    measured_platform = devs[0].platform
+    line = {
         "metric": "videos_per_sec",
-        "value": round(value, 3),
+        "value": round(result.throughput_vps, 3),
         "unit": "videos/s",
-        "vs_baseline": round(value / BASELINE_VIDEOS_PER_SEC, 3),
-    }))
+        "vs_baseline": None,
+        "platform": measured_platform,
+        "num_devices": len(devs),
+        "num_videos": num_videos,
+        "config": os.path.relpath(config, repo_dir),
+    }
+    if measured_platform == "tpu":
+        line["vs_baseline"] = round(
+            result.throughput_vps / BASELINE_VIDEOS_PER_SEC, 3)
+    else:
+        # the baseline is a GPU-hardware number; comparing a host run
+        # against it would publish a meaningless ratio
+        line["note"] = ("vs_baseline omitted: measured platform is %r, "
+                        "not the TPU plugin" % measured_platform)
+    _emit(line)
     if result.termination_flag != 0:
         sys.stderr.write(captured_err.getvalue())
         sys.stderr.write("bench: abnormal termination flag %d\n"

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Retry bench.py against the real TPU until a number is captured.
+#
+# The axon tunnel wedges for 1h+ after an unclean disconnect, so the
+# capture window is unpredictable; this loop keeps attempting for the
+# whole round, recording every attempt (timestamped) so the evidence
+# trail exists even if the final driver window misses again. bench.py
+# itself never SIGKILLs TPU-attached children (they self-exit on
+# internal deadlines), so the loop is safe to leave running.
+#
+# Success: BENCH_TPU.json appears with platform=="tpu" and a value.
+cd "$(dirname "$0")/.." || exit 1
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-40}
+for i in $(seq 1 "$MAX_ATTEMPTS"); do
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  RNB_BENCH_INIT_BUDGET_S=${RNB_BENCH_INIT_BUDGET_S:-900} \
+  RNB_BENCH_PROBE_TIMEOUT_S=${RNB_BENCH_PROBE_TIMEOUT_S:-75} \
+  RNB_BENCH_RUN_BUDGET_S=${RNB_BENCH_RUN_BUDGET_S:-2400} \
+    python bench.py >/tmp/bench_attempt.json 2>/tmp/bench_attempt.err
+  rc=$?
+  line=$(head -1 /tmp/bench_attempt.json)
+  [ -z "$line" ] && line='null'
+  printf '{"ts": "%s", "attempt": %d, "rc": %d, "result": %s}\n' \
+    "$ts" "$i" "$rc" "$line" >> BENCH_ATTEMPTS.jsonl
+  if [ "$rc" -eq 0 ] && printf '%s' "$line" | grep -q '"platform": "tpu"'; then
+    head -1 /tmp/bench_attempt.json > BENCH_TPU.json
+    echo "bench loop: TPU capture succeeded on attempt $i" >&2
+    exit 0
+  fi
+  echo "bench loop: attempt $i rc=$rc; sleeping" >&2
+  sleep "${SLEEP_S:-120}"
+done
+exit 1

@@ -30,8 +30,17 @@ def test_bench_prints_one_json_line(tmp_path):
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, "stdout must be exactly one line: %r" % lines
     payload = json.loads(lines[0])
-    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline",
+                            "platform", "num_devices", "num_videos",
+                            "config", "note"}
     assert payload["metric"] == "videos_per_sec"
     assert payload["unit"] == "videos/s"
     assert payload["value"] > 0
-    assert payload["vs_baseline"] > 0
+    # the baseline ratio is only published for real-TPU measurements;
+    # this forced-CPU run must refuse the comparison and say why
+    assert payload["platform"] == "cpu"
+    assert payload["vs_baseline"] is None
+    assert "not the TPU plugin" in payload["note"]
+    assert payload["num_devices"] >= 1
+    assert payload["num_videos"] == 6
+    assert payload["config"].endswith("r2p1d-tiny.json")
