@@ -16,19 +16,42 @@ from __future__ import annotations
 
 import numpy as np
 
-from rnb_tpu.stage import PaddedBatch, StageModel
+from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
 from rnb_tpu.telemetry import TimeCardList
 
 MAX_ROWS = 15  # max clips per fused batch, matches the loader's max
 
 
 class Batcher(StageModel):
-    """Accumulate `batch` requests, then emit one fused PaddedBatch."""
+    """Accumulate `batch` requests, then emit one fused PaddedBatch.
 
-    def __init__(self, device, batch=1, shapes=None, **kwargs):
+    ``row_buckets`` (optional) pads the fused batch to the smallest
+    bucket holding its valid rows instead of all the way to the ring's
+    max shape — e.g. 6 fused 1-clip videos dispatch as a 6-row batch,
+    not a 15-row one — so the downstream network stage (warmed on the
+    same buckets) spends MXU cycles on mostly-valid rows. ``flush()``
+    emits any partial batch at end-of-stream so the last
+    ``num_videos mod batch`` requests still complete (the reference's
+    batcher simply stranded them, reference batcher.py:17-34).
+    """
+
+    def __init__(self, device, batch=1, shapes=None, max_rows=MAX_ROWS,
+                 consecutive_frames=8, frame_hw=112, row_buckets=None,
+                 **kwargs):
         super().__init__(device)
-        del shapes  # consumed by output_shape_for; payloads carry shape
         self.batch = int(batch)
+        # the fuse capacity comes from this stage's DECLARED output
+        # shape, not from incoming payloads: under upstream row
+        # bucketing an incoming batch's max_rows is its (small) bucket,
+        # while the fused batch may legally grow to the ring shape
+        self._declared_max = [int(s[0]) for s in self.output_shape_for(
+            shapes=shapes, max_rows=max_rows,
+            consecutive_frames=consecutive_frames, frame_hw=frame_hw)]
+        # same validation as the loader's bucketing: typo'd buckets
+        # fail fast instead of silently padding to un-warmed shapes
+        self.row_buckets = (normalize_row_buckets(
+            row_buckets, self._declared_max[0], "stage max rows")
+            if row_buckets else None)
         self._tensors = []      # list of tuples of PaddedBatch
         self._time_cards = []
 
@@ -57,27 +80,51 @@ class Batcher(StageModel):
         if self.batch <= 1:
             return tensors, non_tensors, time_card
 
-        # Validate before mutating state so an oversized request leaves the
-        # accumulator intact and the stage recoverable.
+        # A single request bigger than the fuse capacity can never be
+        # emitted — that is a topology error, fail fast and leave the
+        # accumulator intact.
         for pos, pb in enumerate(tensors):
-            pending = sum(parts[pos].valid for parts in self._tensors)
-            if pending + pb.valid > pb.max_rows:
+            if pb.valid > self._declared_max[pos]:
                 raise ValueError(
-                    "fusing this request would reach %d rows, exceeding the "
-                    "max shape %d; lower the `batch` config or raise the "
-                    "stage max shape"
-                    % (pending + pb.valid, pb.max_rows))
+                    "request carries %d rows, exceeding the stage max "
+                    "shape %d; raise the stage max shape"
+                    % (pb.valid, self._declared_max[pos]))
+
+        # A request that no longer FITS with the pending ones closes
+        # the window early: emit what is pending and start the next
+        # batch with this request. Load-dependent early emission is
+        # ordinary dynamic-batching behavior — aborting the run here
+        # would let one mid-sized video kill the benchmark.
+        early = None
+        if self._tensors and any(
+                sum(parts[pos].valid for parts in self._tensors)
+                + pb.valid > self._declared_max[pos]
+                for pos, pb in enumerate(tensors)):
+            early = self._emit_fused()
 
         self._tensors.append(tensors)
         self._time_cards.append(time_card)
+        if early is not None:
+            return early
         if len(self._time_cards) < self.batch:
             return None, None, None
+        return self._emit_fused()
 
+    def _bucket_for(self, rows: int, max_rows: int) -> int:
+        if self.row_buckets:
+            for bucket in self.row_buckets:
+                if rows <= bucket <= max_rows:
+                    return bucket
+        return max_rows
+
+    def _emit_fused(self):
         fused = []
-        for parts in zip(*self._tensors):
+        for pos, parts in enumerate(zip(*self._tensors)):
             rows = np.concatenate(
                 [np.asarray(pb.data)[: pb.valid] for pb in parts], axis=0)
-            fused.append(PaddedBatch.from_rows(rows, parts[0].max_rows))
+            fused.append(PaddedBatch.from_rows(
+                rows, self._bucket_for(rows.shape[0],
+                                       self._declared_max[pos])))
 
         cards = TimeCardList(self._time_cards)
         self._tensors = []
@@ -86,3 +133,10 @@ class Batcher(StageModel):
         # None rather than one arbitrary constituent's non_tensors
         # (reference batcher.py:34 does the same).
         return tuple(fused), None, cards
+
+    def flush(self):
+        """End-of-stream hook (called by the executor on the exit
+        marker): emit whatever partial batch is pending, or None."""
+        if not self._time_cards:
+            return None
+        return self._emit_fused()

@@ -65,6 +65,9 @@ class BenchmarkResult:
     #: run produced too few records
     p50_latency_ms: Optional[float] = None
     p99_latency_ms: Optional[float] = None
+    #: total clips across every registered completion (0 when the
+    #: pipeline never stamps num_clips) — clips/sec and MFU accounting
+    clips_completed: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -79,6 +82,12 @@ def run_benchmark(config_path: str,
                   xprof: bool = False) -> BenchmarkResult:
     """Programmatic entry used by the CLI, tests and bench.py."""
     _enable_compilation_cache()
+    # multi-host: honor RNB_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID
+    # before the first backend touch — jax.distributed must initialize
+    # ahead of jax.devices() for DCN-attached devices to be visible
+    # (SURVEY.md §2.4 TPU mapping; no-op for single-host runs)
+    from rnb_tpu.parallel.distributed import maybe_initialize
+    maybe_initialize()
     from rnb_tpu.client import bulk_client, poisson_client
     from rnb_tpu.config import load_config
     from rnb_tpu.control import (ChannelFabric, InferenceCounter,
@@ -105,14 +114,19 @@ def run_benchmark(config_path: str,
     # bulk mode pre-enqueues everything; size the queues accordingly
     # (reference benchmark.py:209 — but unlike the reference, account
     # for segmentation fan-out: a step with num_segments=k multiplies
-    # the messages in flight downstream of it)
+    # the messages in flight downstream of it — and for the exit
+    # markers that share the queue with the payload items: a slow
+    # consumer must never leave a producer's end-of-stream markers
+    # undeliverable past the send deadline)
     if mean_interval_ms > 0:
         effective_queue_size = queue_size
     else:
+        from rnb_tpu.control import NUM_EXIT_MARKERS
         seg_factor = 1
         for step in config.steps:
             seg_factor *= step.num_segments
-        effective_queue_size = num_videos * seg_factor + num_runners + 1
+        effective_queue_size = (num_videos * seg_factor + num_runners
+                                + max(NUM_EXIT_MARKERS, num_runners) + 1)
     fabric = ChannelFabric(config, effective_queue_size)
 
     threads = []
@@ -237,8 +251,10 @@ def run_benchmark(config_path: str,
     from rnb_tpu.runner import NUM_SUMMARY_SKIPS
     from rnb_tpu.telemetry import latency_percentiles
     latencies = []
+    clips_completed = 0
     for s in summary_sink:
         latencies.extend(s.latencies_ms(NUM_SUMMARY_SKIPS))
+        clips_completed += s.total_clips()
     pct = latency_percentiles(latencies)
     p50, p99 = pct.get(50.0), pct.get(99.0)
     if pct and print_progress:
@@ -255,6 +271,7 @@ def run_benchmark(config_path: str,
         log_dir=logroot(job_id, base=log_base),
         p50_latency_ms=p50,
         p99_latency_ms=p99,
+        clips_completed=clips_completed,
     )
 
 

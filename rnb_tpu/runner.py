@@ -118,6 +118,16 @@ def _block_on(payload) -> None:
     jax.block_until_ready([pb.data for pb in payload])
 
 
+def _eos_flush(model):
+    """End-of-stream marker seen: the stage's pending partial batch (if
+    it accumulates one) becomes the stream's last item. Returns the
+    (tensors, non_tensors, time_card) to publish, or None."""
+    flushed = model.flush() if hasattr(model, "flush") else None
+    if flushed is None or flushed[2] is None:
+        return None
+    return flushed
+
+
 def validate_payload(declared, payload, where: str) -> None:
     """Assert a stage's produced payload matches its declared
     ``output_shape_for``: same tensor count, same trailing dims, row
@@ -181,41 +191,98 @@ def runner(ctx: RunnerContext) -> None:
     ring_counter = 0  # next output slot (reference runner.py:60-61)
     old_counter_value = 0
 
+    # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
+    # first stage exposing submit()/complete() gets its next requests'
+    # host work (decode) kicked off while the head request's device work
+    # runs. Depth 0 (or any tensor-input stage) keeps the classic loop.
+    prefetch_depth = 0
+    if (model is not None and ctx.input_rings is None
+            and hasattr(model, "submit") and hasattr(model, "complete")):
+        prefetch_depth = int(getattr(model, "prefetch_depth", 0) or 0)
+    from collections import deque
+    pending = deque()  # (handle, non_tensors, time_card) submitted
+    saw_marker = False
+
     try:
         if model is not None:
             while not ctx.termination.terminated:
-                try:
-                    item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
-                except queue.Empty:
-                    continue
-                if item is None:
-                    break  # end-of-stream marker
-
-                signal, non_tensors, time_card = item
-                time_card.add_device(ctx.device.label)
-                time_card.record("runner%d_start" % ctx.step_idx)
-
-                if signal is not None:
-                    ring = ctx.input_rings[signal.group_idx][
-                        signal.instance_idx]
-                    slot = ring.slots[signal.tensor_idx]
-                    tensors = slot.read()
-                    if tensors is None:
-                        # an abort-path release_all() cleared the slot
-                        # between our queue pop and this read — exit
-                        # (reference runner.py:96-100)
-                        break
-                    slot.release()
+                handle = None
+                # end-of-stream flush: a marker with an accumulating
+                # stage (batcher) still holding a partial batch emits
+                # that batch as one last item before draining, so the
+                # final ``num_videos mod batch`` requests complete
+                # instead of stranding the run
+                flushed, eos = None, False
+                if prefetch_depth > 0:
+                    while (not saw_marker
+                           and len(pending) < prefetch_depth + 1):
+                        try:
+                            item = ctx.in_queue.get(block=not pending,
+                                                    timeout=QUEUE_POLL_S)
+                        except queue.Empty:
+                            break
+                        if item is None:
+                            saw_marker = True
+                            break
+                        _sig, nt, tc = item
+                        tc.add_device(ctx.device.label)
+                        tc.record("runner%d_start" % ctx.step_idx)
+                        pending.append((model.submit(nt, tc), nt, tc))
+                    if pending:
+                        handle, non_tensors, time_card = pending.popleft()
+                        signal, tensors = None, None
+                    elif saw_marker:
+                        flushed = _eos_flush(model)
+                        if flushed is None:
+                            break  # end-of-stream, all work drained
+                        eos = True
+                    else:
+                        continue
                 else:
-                    tensors = None
+                    try:
+                        item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
+                    except queue.Empty:
+                        continue
+                    if item is None:
+                        flushed = _eos_flush(model)
+                        if flushed is None:
+                            break  # end-of-stream marker
+                        eos = True
+                    else:
+                        signal, non_tensors, time_card = item
+                        time_card.add_device(ctx.device.label)
+                        time_card.record("runner%d_start" % ctx.step_idx)
 
-                time_card.record("inference%d_start" % ctx.step_idx)
-                tensors_out, non_tensors_out, time_card = model(
-                    tensors, non_tensors, time_card)
-                if time_card is None:
-                    # stage swallowed the item (accumulating batcher /
-                    # aggregator) — nothing moves downstream
-                    continue
+                        if signal is not None:
+                            ring = ctx.input_rings[signal.group_idx][
+                                signal.instance_idx]
+                            slot = ring.slots[signal.tensor_idx]
+                            tensors = slot.read()
+                            if tensors is None:
+                                # an abort-path release_all() cleared the
+                                # slot between our queue pop and this
+                                # read — exit (reference runner.py:96-100)
+                                break
+                            slot.release()
+                        else:
+                            tensors = None
+
+                if flushed is not None:
+                    # constituents carry their own runner/inference start
+                    # stamps from when the batcher swallowed them
+                    tensors_out, non_tensors_out, time_card = flushed
+                else:
+                    time_card.record("inference%d_start" % ctx.step_idx)
+                    if handle is not None:
+                        tensors_out, non_tensors_out, time_card = \
+                            model.complete(handle, non_tensors, time_card)
+                    else:
+                        tensors_out, non_tensors_out, time_card = model(
+                            tensors, non_tensors, time_card)
+                    if time_card is None:
+                        # stage swallowed the item (accumulating batcher
+                        # / aggregator) — nothing moves downstream
+                        continue
                 validate_payload(declared_shapes, tensors_out,
                                  "step %d %s" % (ctx.step_idx,
                                                  ctx.model_class_path))
@@ -236,23 +303,29 @@ def runner(ctx: RunnerContext) -> None:
                         break
 
                 if ctx.out_queues is None:
-                    # final step: count completions, detect the target
+                    # final step: count completions, detect the target.
+                    # Register BEFORE any target-reached break: a
+                    # completion added to the counter must appear in some
+                    # timing table even when a sibling instance raised
+                    # the flag while this one was mid-inference — the
+                    # reference registered every completed record
+                    # (reference runner.py:176-202)
                     n = len(time_card) if isinstance(time_card,
                                                      TimeCardList) else 1
                     old, new = ctx.counter.add(n)
                     if progress_bar is not None and new > old_counter_value:
                         progress_bar.update(new - old_counter_value)
                         old_counter_value = new
+                    cards = time_card.time_cards if isinstance(
+                        time_card, TimeCardList) else [time_card]
+                    for tc in cards:
+                        summary.register(tc)
                     if new >= ctx.num_videos:
                         if old < ctx.num_videos:
                             ctx.termination.raise_flag(
                                 TerminationFlag.TARGET_NUM_VIDEOS_REACHED)
                         else:
                             break  # someone else already hit the target
-                    cards = time_card.time_cards if isinstance(
-                        time_card, TimeCardList) else [time_card]
-                    for tc in cards:
-                        summary.register(tc)
                 else:
                     out_idx = selector.select(tensors_out, non_tensors_out,
                                               time_card)
@@ -277,10 +350,18 @@ def runner(ctx: RunnerContext) -> None:
                         ctx.termination.raise_flag(
                             TerminationFlag.FRAME_QUEUE_FULL)
                         break
+                if eos:
+                    break  # the flushed item was the stream's last
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
     finally:
+        # abort/drain path: retire any prefetched decodes whose results
+        # will never be used so native-pool tickets don't pin buffers
+        if pending and hasattr(model, "discard"):
+            for handle, nt, _tc in pending:
+                model.discard(handle, nt)
+        pending.clear()
         # drain: the LAST producer on each edge marks end-of-stream, so
         # markers can never overtake a slower sibling replica's real
         # items (improves on reference runner.py:238-245 which let any
@@ -304,6 +385,15 @@ def runner(ctx: RunnerContext) -> None:
                 for ring in rings:
                     if ring is not None:
                         ring.release_all()
+        # async stages (mesh runner) drain outstanding device work
+        # BEFORE the finish barrier so the measured window covers every
+        # dispatched inference (the analog of the reference's final
+        # stream.synchronize discipline)
+        if model is not None and hasattr(model, "finalize"):
+            try:
+                model.finalize()
+            except Exception:
+                traceback.print_exc()
         try:
             ctx.fin_bar.wait()
         except threading.BrokenBarrierError:

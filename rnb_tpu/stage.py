@@ -62,6 +62,27 @@ class PaddedBatch:
         return PaddedBatch(np.concatenate([rows, pad], axis=0), n)
 
 
+def normalize_row_buckets(row_buckets, max_rows: int, what: str
+                          ) -> Tuple[int, ...]:
+    """Sorted, validated bucket tuple; ``(max_rows,)`` when disabled.
+
+    The one validation every bucketing stage (loader, batcher) shares:
+    buckets are distinct positive row counts ending exactly at the
+    stage's max shape — a typo'd set must fail fast, not silently pad
+    to an un-warmed shape.
+    """
+    if not row_buckets:
+        return (int(max_rows),)
+    buckets = sorted(int(b) for b in row_buckets)
+    if buckets[0] < 1 or len(set(buckets)) != len(buckets):
+        raise ValueError("row_buckets %r must be distinct positive row "
+                         "counts" % (row_buckets,))
+    if buckets[-1] != max_rows:
+        raise ValueError("row_buckets %r must end at %s=%d"
+                         % (row_buckets, what, max_rows))
+    return tuple(buckets)
+
+
 class StageModel:
     """Abstract contract every pipeline stage implements.
 

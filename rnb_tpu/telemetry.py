@@ -103,6 +103,10 @@ class TimeCard:
         child.timings = OrderedDict(self.timings)
         child.sub_id = sub_id
         child.num_parent_timings = len(self.timings)
+        if hasattr(self, "num_clips"):
+            # content stamps (loader's num_clips) ride along with every
+            # segment so routing and clip accounting survive the fork
+            child.num_clips = self.num_clips
         child.devices = list(self.devices)
         return child
 
@@ -159,6 +163,10 @@ class TimeCard:
                 merged.devices.append((flat[0],))
             else:
                 merged.devices.append(flat)
+        if hasattr(ordered[0], "num_clips"):
+            # the content stamp is per-request, identical on every
+            # sibling fork — keep it once
+            merged.num_clips = ordered[0].num_clips
         return merged
 
 
@@ -202,6 +210,9 @@ class TimeCardSummary:
         self.summary: "OrderedDict[str, List[float]]" = OrderedDict()
         self.keys: List[str] = []
         self.devices_per_inference: List[List[tuple]] = []
+        # per-record clip counts (0 when the pipeline never stamped
+        # num_clips) — feeds clips/sec and MFU accounting in bench.py
+        self.clip_counts: List[int] = []
 
     def register(self, time_card: TimeCard) -> None:
         if not self.summary:
@@ -215,6 +226,11 @@ class TimeCardSummary:
         for key, ts in time_card.timings.items():
             self.summary[key].append(ts)
         self.devices_per_inference.append(time_card.devices)
+        self.clip_counts.append(int(getattr(time_card, "num_clips", 0)))
+
+    def total_clips(self) -> int:
+        """Sum of registered records' ``num_clips`` stamps."""
+        return sum(self.clip_counts)
 
     def num_records(self) -> int:
         return len(self.summary[self.keys[0]]) if self.keys else 0

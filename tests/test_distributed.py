@@ -1,0 +1,83 @@
+"""Two-process jax.distributed smoke over the CPU backend.
+
+Proves the multi-host path end-to-end on one machine: two controller
+processes initialize through rnb_tpu.parallel.distributed's env
+contract (the same one rnb_tpu.benchmark honors at launch), see each
+other's devices, build ONE global mesh, and run a cross-process psum —
+the DCN-scale analog of SURVEY.md §2.4's comm backend.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import sys
+
+import numpy as np
+
+from rnb_tpu.parallel.distributed import (global_mesh, is_primary,
+                                          maybe_initialize, process_count)
+
+assert maybe_initialize() is True
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+n = len(jax.devices())
+assert n == 4, "expected 2 procs x 2 virtual devices, saw %d" % n
+
+mesh = global_mesh(axis_names=("dp",))
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+x = jax.jit(lambda: jnp.arange(n, dtype=jnp.float32),
+            out_shardings=NamedSharding(mesh, P("dp")))()
+psum = jax.jit(shard_map(lambda a: jax.lax.psum(a, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P()))
+total = float(np.asarray(psum(x)).sum())
+assert total == float(np.arange(n).sum()), total
+if is_primary():
+    print("DIST-OK total=%s" % total)
+sys.stdout.flush()
+"""
+
+
+def test_two_process_distributed_psum(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "RNB_TPU_COORDINATOR": "127.0.0.1:%d" % port,
+            "RNB_TPU_NUM_PROCESSES": "2",
+            "RNB_TPU_PROCESS_ID": str(pid),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": REPO,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+    assert any("DIST-OK" in out for _rc, out, _err in outs), outs

@@ -116,3 +116,39 @@ def test_mesh_stage_pads_indivisible_clip_axis():
                              train=False)
         want = int(np.asarray(logits, np.float32).sum(axis=0).argmax())
         assert pred == want, "valid=%d" % valid
+
+
+def test_mesh_pipeline_dp_batched(tmp_path):
+    """dp=2 x sp=2: two queued videos fuse into one sharded dispatch;
+    async device preds; flush handles an odd video count."""
+    cfg = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 8,
+             "raw_output": True,
+             "max_clips": TINY["max_clips"],
+             "consecutive_frames": TINY["consecutive_frames"],
+             "num_clips_population": [1, 2],
+             "weights": [3, 1],
+             "num_warmups": 1},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DMeshRunner",
+             "queue_groups": [{"devices": [1], "in_queue": 0}],
+             "mesh_devices": [0, 1, 2, 3],
+             "dp": 2,
+             **TINY},
+        ],
+    }
+    path = tmp_path / "mesh-dp.json"
+    path.write_text(json.dumps(cfg))
+    # 7 % dp != 0: the last video completes only through flush()
+    res = run_benchmark(str(path), mean_interval_ms=0, num_videos=7,
+                        log_base=str(tmp_path / "logs"),
+                        print_progress=False)
+    assert res.termination_flag == TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    reports = [f for f in os.listdir(res.log_dir) if "group" in f]
+    with open(os.path.join(res.log_dir, reports[0])) as f:
+        lines = f.read().strip().split("\n")
+    assert len(lines) - 1 >= 7

@@ -190,3 +190,54 @@ def test_broken_stage_class_fails_fast(tmp_path):
                         queue_size=10, log_base=str(tmp_path / "logs"),
                         print_progress=False)
     assert res.termination_flag == TerminationFlag.INTERNAL_ERROR
+
+
+def test_target_race_registers_inflight_record(tmp_path):
+    """A completion counted after a sibling already hit the target must
+    still land in the timing table (reference runner.py:176-202
+    registered every completed record; round-3 verdict weak#6)."""
+    import queue
+    import threading
+
+    from rnb_tpu.control import InferenceCounter, TerminationState
+    from rnb_tpu.devices import DeviceSpec
+    from rnb_tpu.runner import RunnerContext, runner
+    from rnb_tpu.telemetry import TimeCard
+
+    num_videos = 5
+    counter = InferenceCounter()
+    counter.add(num_videos)  # a sibling instance already hit the target
+
+    tc = TimeCard(99)
+    tc.record("enqueue_filename")
+    in_queue = queue.Queue()
+    in_queue.put((None, "video-99", tc))
+
+    sink: list = []
+    ctx = RunnerContext(
+        in_queue=in_queue,
+        out_queues=None,
+        queue_selector_path="rnb_tpu.selector.RoundRobinSelector",
+        print_progress=False,
+        job_id="race-test",
+        device=DeviceSpec(-1),
+        group_idx=0,
+        instance_idx=0,
+        counter=counter,
+        num_videos=num_videos,
+        termination=TerminationState(),
+        step_idx=0,
+        sta_bar=threading.Barrier(1),
+        fin_bar=threading.Barrier(1),
+        model_class_path="tests.pipeline_helpers.TinySink",
+        num_segments=1,
+        input_rings=None,
+        output_ring=None,
+        log_base=str(tmp_path / "logs"),
+        summary_sink=sink,
+    )
+    runner(ctx)
+    assert counter.value == num_videos + 1
+    assert len(sink) == 1
+    # the in-flight record was registered despite the sibling's target
+    assert len(sink[0].latencies_ms(num_skips=0)) == 1

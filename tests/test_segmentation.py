@@ -91,3 +91,32 @@ def test_rnb_topology_routing_and_batching(tmp_path):
     # both worked
     reports = [f for f in os.listdir(res.log_dir) if "group" in f]
     assert len(reports) == 1
+
+
+def test_rnb_topology_flushes_partial_batch_at_eos(tmp_path):
+    """num_videos not divisible by the batch size must still complete:
+    the executor flushes the batcher's partial batch on the exit marker
+    (the reference's batcher stranded those requests)."""
+    cfg = {
+        "video_path_iterator": "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 10, "rows_per_video": 1},
+            {"model": "rnb_tpu.batcher.Batcher",
+             "queue_groups": [
+                 {"devices": [1], "in_queue": 0, "out_queues": [0],
+                  "batch": 4}],
+             "num_shared_tensors": 10, "shapes": [[4, 2]]},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [-1], "in_queue": 0}]},
+        ],
+    }
+    path = os.path.join(str(tmp_path), "rnb-flush.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    # 10 % 4 == 2: without the flush the last 2 requests never complete
+    res = run_benchmark(path, mean_interval_ms=0, num_videos=10,
+                        queue_size=100, log_base=str(tmp_path / "logs"),
+                        print_progress=False)
+    assert res.termination_flag == TerminationFlag.TARGET_NUM_VIDEOS_REACHED

@@ -60,16 +60,32 @@ def test_batcher_passthrough_when_batch_leq_one():
     assert out == (tensors, "meta", tc)
 
 
-def test_batcher_overflow_raises_and_recovers():
+def test_batcher_emits_early_when_request_would_overflow():
+    # 8+8 > 15: the second request closes the window early — the
+    # pending batch is emitted and the new request starts the next one
+    # (one mid-sized video must not abort the run)
     b = Batcher(device=None, batch=2)
-    b(_clip_batch(8, 1.0), None, TimeCard(0))
-    with pytest.raises(ValueError):
-        b(_clip_batch(8, 2.0), None, TimeCard(1))
-    # the oversized request was rejected without wedging the accumulator:
-    # a small follow-up request completes the fused batch
+    assert b(_clip_batch(8, 1.0), None, TimeCard(0)) == (None, None, None)
+    tensors, _, card = b(_clip_batch(8, 2.0), None, TimeCard(1))
+    assert tensors[0].valid == 8
+    assert len(card) == 1
+    np.testing.assert_array_equal(
+        tensors[0].valid_data()[:, 0, 0, 0, 0], [1.0] * 8)
+    # the displaced request is pending; a follow-up completes its batch
     tensors, _, card = b(_clip_batch(2, 3.0), None, TimeCard(2))
     assert tensors[0].valid == 10
     assert len(card) == 2
+
+
+def test_batcher_rejects_single_oversized_request():
+    # a lone request beyond the DECLARED capacity is a topology error
+    b = Batcher(device=None, batch=2, shapes=[[4, 3, 8, 112, 112]])
+    with pytest.raises(ValueError):
+        b(_clip_batch(8, 1.0), None, TimeCard(0))
+    # fail-fast left the accumulator intact
+    assert b(_clip_batch(2, 2.0), None, TimeCard(1)) == (None, None, None)
+    tensors, _, card = b(_clip_batch(2, 3.0), None, TimeCard(2))
+    assert tensors[0].valid == 4
 
 
 def test_round_robin_selector_cycles():
@@ -115,3 +131,32 @@ def test_validate_payload_contract():
     validate_payload(None, (), "step")
     with pytest.raises(ValueError):
         validate_payload(None, ok, "step")
+
+
+def test_batcher_row_buckets_pad_to_bucket():
+    b = Batcher(device=None, batch=3, row_buckets=[4, 15])
+    b(_clip_batch(1, 1.0), None, TimeCard(0))
+    b(_clip_batch(1, 2.0), None, TimeCard(1))
+    tensors, _, card = b(_clip_batch(1, 3.0), None, TimeCard(2))
+    # 3 valid rows pad to the 4 bucket, not the 15 max shape
+    assert tensors[0].valid == 3
+    assert tensors[0].data.shape[0] == 4
+    # an oversized fuse still pads to the max shape
+    b2 = Batcher(device=None, batch=2, row_buckets=[4, 15])
+    b2(_clip_batch(4, 1.0), None, TimeCard(0))
+    tensors, _, _ = b2(_clip_batch(4, 2.0), None, TimeCard(1))
+    assert tensors[0].data.shape[0] == 15
+
+
+def test_batcher_flush_emits_partial_batch():
+    b = Batcher(device=None, batch=4, row_buckets=[4, 15])
+    assert b.flush() is None  # nothing pending
+    b(_clip_batch(1, 1.0), None, TimeCard(0))
+    b(_clip_batch(1, 2.0), None, TimeCard(1))
+    tensors, non_tensors, card = b.flush()
+    assert len(card) == 2
+    assert tensors[0].valid == 2
+    assert tensors[0].data.shape[0] == 4
+    np.testing.assert_array_equal(
+        tensors[0].valid_data()[:, 0, 0, 0, 0], [1.0, 2.0])
+    assert b.flush() is None  # state reset
