@@ -6,18 +6,31 @@ framework: the 2-stage decode→R(2+1)D pipeline of
 the same topology behind the reference's only published number
 (11.3 videos/s on one GPU, reference README.md:176-178).
 
-Prints exactly ONE JSON line:
+**Real decode by default.** The reference's number includes real video
+decode through NVVL (reference models/r2p1d/model.py:140-151), so this
+bench decodes real files too: it generates (once, cached under
+``data/bench_y4m``) a y4m dataset via scripts/make_dataset.py and runs
+it through the native C++ decode pool. ``RNB_BENCH_DATASET=synth``
+restores the synthetic-id mode for apples-to-apples comparison with
+rounds ≤3; the emitted ``decode_backend`` key states which path was
+measured.
+
+Prints exactly ONE JSON line with throughput plus the evidence keys the
+perf claim needs to be auditable:
   {"metric": "videos_per_sec", "value": N, "unit": "videos/s",
-   "vs_baseline": N / 11.3, "platform": "tpu", "num_devices": 1,
-   "num_videos": 500, "config": "configs/r2p1d-whole.json"}
+   "vs_baseline": N / 11.3, "platform": "tpu", "decode_backend": "...",
+   "p50_ms": N, "p99_ms": N, "clips_per_sec": N,
+   "gflops_per_clip": 42.14, "tflops": N, "mfu": N, ...}
 and on unrecoverable failure a structured error line instead:
   {"metric": "videos_per_sec", "value": null, "unit": "videos/s",
    "vs_baseline": null, "error": "..."}
 
 ``vs_baseline`` is only reported when the measured platform is the TPU
 plugin — the reference number is a GPU-hardware number and comparing a
-host-CPU run against it would be meaningless (and unauditable, since
-round-2 review noted nothing *asserted* what was measured).
+host-CPU run against it would be meaningless. ``mfu`` is analytic
+conv+dense FLOPs (rnb_tpu/models/r2p1d/flops.py, cross-checked against
+XLA cost_analysis in tests) divided by the device's spec-sheet bf16
+peak; it is null on platforms with no known peak.
 
 Backend resilience: the TPU in this environment is reached through a
 tunnel that can be transiently unavailable (and, when wedged, makes
@@ -28,9 +41,11 @@ exit; an external SIGKILL on a TPU-attached process is what wedges the
 tunnel in the first place) — retrying with backoff within a time
 budget.
 
-Env knobs: RNB_BENCH_VIDEOS (default 500), RNB_BENCH_CONFIG,
-RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk), RNB_BENCH_PLATFORM
-(e.g. "cpu" to force the CPU backend for smoke runs; skips the probe),
+Env knobs: RNB_BENCH_VIDEOS (default 2000: >10s measured window on
+TPU), RNB_BENCH_CONFIG, RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk),
+RNB_BENCH_DATASET (y4m|synth, default y4m), RNB_TPU_DATA_ROOT (use an
+existing dataset instead of generating), RNB_BENCH_PLATFORM (e.g.
+"cpu" to force the CPU backend for smoke runs; skips the probe),
 RNB_BENCH_INIT_BUDGET_S (default 600) total probe budget,
 RNB_BENCH_PROBE_TIMEOUT_S (default 90) per-attempt deadline.
 """
@@ -138,9 +153,162 @@ def _emit_error(msg: str) -> int:
     return 1
 
 
+def _dataset_spec():
+    """Generated-dataset geometry (env-overridable for smoke tests):
+    128 source frames so the sampler can place 15 non-overlapping
+    8-frame clips (15*8=120 <= 128 keeps the reference's skewed [1,15]
+    clip population intact), 192x256 source pixels so decode+resize
+    does real work per frame."""
+    e = os.environ.get
+    return ("--labels", e("RNB_BENCH_DATASET_LABELS", "4"),
+            "--videos-per-label", e("RNB_BENCH_DATASET_VPL", "8"),
+            "--frames", e("RNB_BENCH_DATASET_FRAMES", "128"),
+            "--size", e("RNB_BENCH_DATASET_SIZE", "192x256"))
+
+
+def _count_y4m(root: str) -> int:
+    """Count videos using EXACTLY the pipeline iterator's scan rule
+    (root/<label>/*.y4m, one level — R2P1DVideoPathIterator): a dataset
+    this count accepts is a dataset the measured run actually consumes,
+    so decode_backend can never claim real decode over a layout the
+    iterator would silently skip (falling back to synth:// ids)."""
+    if not os.path.isdir(root):
+        return 0
+    total = 0
+    for label in os.listdir(root):
+        label_dir = os.path.join(root, label)
+        if os.path.isdir(label_dir):
+            total += sum(1 for v in os.listdir(label_dir)
+                         if v.endswith(".y4m"))
+    return total
+
+
+def _ensure_dataset(repo_dir: str):
+    """Prepare the decode workload; -> (decode_backend, dataset_root).
+
+    y4m mode (default): reuse RNB_TPU_DATA_ROOT if it already holds
+    videos, else generate the procedural y4m tree once under
+    data/bench_y4m; exports RNB_TPU_DATA_ROOT so the pipeline's path
+    iterator and decode warm-up find it. synth mode: clears the root so
+    the loader falls back to synth:// ids (rounds <=3 behavior).
+    """
+    mode = os.environ.get("RNB_BENCH_DATASET", "y4m")
+    if mode == "synth":
+        os.environ.pop("RNB_TPU_DATA_ROOT", None)
+        return "synthetic", None
+    if mode != "y4m":
+        raise ValueError("RNB_BENCH_DATASET must be y4m or synth, got %r"
+                         % mode)
+    root = os.environ.get("RNB_TPU_DATA_ROOT") or os.path.join(
+        repo_dir, "data", "bench_y4m")
+    if _count_y4m(root) == 0:
+        sys.stderr.write("bench: generating y4m dataset under %s\n" % root)
+        subprocess.run(
+            [sys.executable,
+             os.path.join(repo_dir, "scripts", "make_dataset.py"),
+             "--root", root, *_dataset_spec()],
+            check=True, stdout=subprocess.DEVNULL)
+        if _count_y4m(root) == 0:
+            raise RuntimeError(
+                "dataset generation produced no root/label/*.y4m videos "
+                "under %s" % root)
+    os.environ["RNB_TPU_DATA_ROOT"] = root
+    from rnb_tpu.decode.native import native_available
+    backend = "native-y4m" if native_available() else "numpy-y4m"
+    return backend, root
+
+
+def _config_stage_views(config: dict):
+    """Yield (step, [merged kwargs per queue_group]) with group keys
+    overriding step keys — mirroring the runtime's kwargs_for_group, so
+    the evidence extractors below see the same semantics the stage
+    constructors do (a group-level sync_preds/layer_sizes override must
+    not be invisible to the published evidence)."""
+    for step in config.get("pipeline", []):
+        groups = step.get("queue_groups") or [{}]
+        views = []
+        for group in groups:
+            merged = dict(step)
+            merged.update(group)
+            views.append(merged)
+        yield step, views
+
+
+def _flops_per_clip_for_config(config: dict) -> float:
+    """Analytic conv+dense FLOPs one clip costs across every network
+    stage of the pipeline (a layer-split pipeline sums its ranges back
+    to the full net). Every network-shape override a step can carry
+    (layer_sizes, num_classes, factored_shortcut, consecutive_frames)
+    is forwarded, so the published evidence matches the network that
+    actually ran, not the R18 default."""
+    from rnb_tpu.models.r2p1d.flops import range_flops_per_clip
+    total = 0
+    for step, views in _config_stage_views(config):
+        model = step.get("model", "")
+        if not model.endswith((".R2P1DSingleStep", ".R2P1DMeshRunner",
+                               ".R2P1DRunner")):
+            continue
+        # one clip flows through ONE replica of the step, so count the
+        # step once — from the first group's merged view (replica groups
+        # share the network shape in every topology this carries)
+        view = views[0]
+        kwargs = dict(
+            consecutive_frames=view.get("consecutive_frames", 8),
+            num_classes=view.get("num_classes", 400),
+            factored_shortcut=view.get("factored_shortcut", False))
+        if view.get("layer_sizes") is not None:
+            kwargs["layer_sizes"] = tuple(view["layer_sizes"])
+        if model.endswith(".R2P1DRunner"):
+            start = view.get("start_index", 1)
+            end = view.get("end_index", 5)
+        else:
+            start, end = 1, 5
+        total += range_flops_per_clip(start, end, **kwargs)
+    return float(total)
+
+
+def _latency_semantics(config: dict) -> str:
+    """\"completion\" when every stage blocks before stamping
+    inference_finish; \"dispatch\" when any stage publishes async
+    (async_dispatch step flag, or a mesh stage with sync_preds false) —
+    the emitted p50/p99 then measure dispatch, and the evidence line
+    must say so."""
+    for step, views in _config_stage_views(config):
+        for view in views:
+            if view.get("async_dispatch"):
+                return "dispatch"
+            if (view.get("model", step.get("model", ""))
+                    .endswith(".R2P1DMeshRunner")
+                    and view.get("sync_preds") is False):
+                return "dispatch"
+    return "completion"
+
+
+def _devices_used(config: dict) -> int:
+    """Distinct accelerator devices the topology touches (host -1
+    excluded; a mesh stage counts its whole sub-mesh, including a
+    group-level mesh_devices override)."""
+    used = set()
+    for _step, views in _config_stage_views(config):
+        for view in views:
+            for dev in view.get("mesh_devices", []):
+                used.add(int(dev))
+            for dev in view.get("devices", []):
+                if int(dev) >= 0:
+                    used.add(int(dev))
+    return max(1, len(used))
+
+
 def main() -> int:
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, repo_dir)
+
+    try:
+        decode_backend, dataset_root = _ensure_dataset(repo_dir)
+    except Exception as e:  # noqa: BLE001 — one-line contract
+        return _emit_error("dataset preparation failed: %s: %s"
+                           % (type(e).__name__, e))
+
     platform = os.environ.get("RNB_BENCH_PLATFORM")
     if platform:
         # env-var JAX_PLATFORMS alone is overridden by the site hook in
@@ -154,13 +322,11 @@ def main() -> int:
         if err:
             return _emit_error(err)
 
-    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "500"))
+    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "2000"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",
         os.path.join(repo_dir, "configs", "r2p1d-whole.json"))
     mean_interval = int(os.environ.get("RNB_BENCH_MEAN_INTERVAL_MS", "0"))
-
-    from rnb_tpu.benchmark import run_benchmark
 
     # the probe leaves one gap: the tunnel can wedge *between* the
     # probe and run_benchmark's own backend init, hanging this process
@@ -186,19 +352,44 @@ def main() -> int:
     try:
         with contextlib.redirect_stdout(io.StringIO()), \
                 contextlib.redirect_stderr(captured_err):
-            result = run_benchmark(
-                config_path=config,
-                mean_interval_ms=mean_interval,
-                num_videos=num_videos,
-                log_base=os.environ.get("RNB_BENCH_LOG_BASE", "logs"),
-                print_progress=False,
-                seed=0,
-            )
+            line, termination_flag = measure(
+                config, num_videos, mean_interval,
+                decode_backend, dataset_root,
+                log_base=os.environ.get("RNB_BENCH_LOG_BASE", "logs"))
     except Exception as e:  # noqa: BLE001 — one-line contract on any failure
         done.set()
         sys.stderr.write(captured_err.getvalue())
         return _emit_error("%s: %s" % (type(e).__name__, e))
     done.set()
+    _emit(line)
+    if termination_flag != 0:
+        sys.stderr.write(captured_err.getvalue())
+        sys.stderr.write("bench: abnormal termination flag %d\n"
+                         % termination_flag)
+        return 1
+    return 0
+
+
+def measure(config: str, num_videos: int, mean_interval: int,
+            decode_backend: str, dataset_root, log_base: str = "logs",
+            seed: int = 0):
+    """Run one benchmark job; -> (evidence line dict, termination flag).
+
+    Shared by the headline bench (one line to stdout) and
+    scripts/bench_matrix.py (one row per config in the matrix artifact).
+    """
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    with open(config) as f:
+        config_dict = json.load(f)
+    from rnb_tpu.benchmark import run_benchmark
+    result = run_benchmark(
+        config_path=config,
+        mean_interval_ms=mean_interval,
+        num_videos=num_videos,
+        log_base=log_base,
+        print_progress=False,
+        seed=seed,
+    )
 
     # record what was actually measured: the live backend, not the
     # probe's claim (the tunnel could have re-resolved in between)
@@ -211,10 +402,36 @@ def main() -> int:
         "unit": "videos/s",
         "vs_baseline": None,
         "platform": measured_platform,
+        "device_kind": devs[0].device_kind,
         "num_devices": len(devs),
+        "devices_used": _devices_used(config_dict),
         "num_videos": num_videos,
+        "mean_interval_ms": mean_interval,
         "config": os.path.relpath(config, repo_dir),
+        "decode_backend": decode_backend,
+        "dataset": (os.path.relpath(dataset_root, repo_dir)
+                    if dataset_root else None),
+        "measured_window_s": round(result.total_time_s, 3),
+        "p50_ms": (round(result.p50_latency_ms, 3)
+                   if result.p50_latency_ms is not None else None),
+        "p99_ms": (round(result.p99_latency_ms, 3)
+                   if result.p99_latency_ms is not None else None),
+        "latency_semantics": _latency_semantics(config_dict),
     }
+    # device-utilization evidence: analytic conv+dense FLOPs (see
+    # rnb_tpu/models/r2p1d/flops.py) x measured clip rate vs spec peak
+    from rnb_tpu.models.r2p1d.flops import peak_tflops_for
+    flops_per_clip = _flops_per_clip_for_config(config_dict)
+    clips_per_sec = (result.clips_completed / result.total_time_s
+                     if result.total_time_s > 0 else 0.0)
+    line["clips_per_sec"] = round(clips_per_sec, 3)
+    line["gflops_per_clip"] = round(flops_per_clip / 1e9, 3)
+    tflops = clips_per_sec * flops_per_clip / 1e12
+    line["tflops"] = round(tflops, 3)
+    peak = peak_tflops_for(devs[0].device_kind)
+    line["peak_tflops_per_device"] = peak
+    line["mfu"] = (round(tflops / (peak * line["devices_used"]), 4)
+                   if peak else None)
     if measured_platform == "tpu":
         line["vs_baseline"] = round(
             result.throughput_vps / BASELINE_VIDEOS_PER_SEC, 3)
@@ -223,13 +440,7 @@ def main() -> int:
         # against it would publish a meaningless ratio
         line["note"] = ("vs_baseline omitted: measured platform is %r, "
                         "not the TPU plugin" % measured_platform)
-    _emit(line)
-    if result.termination_flag != 0:
-        sys.stderr.write(captured_err.getvalue())
-        sys.stderr.write("bench: abnormal termination flag %d\n"
-                         % result.termination_flag)
-        return 1
-    return 0
+    return line, result.termination_flag
 
 
 if __name__ == "__main__":
