@@ -1,10 +1,15 @@
 """Headline benchmark: videos/sec through the flagship pipeline.
 
 Reproduces the reference's benchmark methodology (SURVEY.md §6) on this
-framework: the 2-stage decode→R(2+1)D pipeline of
-``configs/r2p1d-whole.json`` driven in bulk (max-throughput) mode —
-the same topology behind the reference's only published number
-(11.3 videos/s on one GPU, reference README.md:176-178).
+framework, driven in bulk (max-throughput) mode against the baseline
+from the reference's only published number (11.3 videos/s on one GPU
+over config/r2p1d-whole.json, reference README.md:176-178). The default
+topology here is ``configs/rnb-1chip.json`` — the reference's own
+flagship Replicate & Batch idea (content-routed lanes + dynamic
+batching, reference config/rnb.json) on a single chip; it outperforms
+the plain 2-stage ``r2p1d-whole`` topology, which remains measured
+side-by-side in scripts/bench_matrix.py for the like-for-like
+comparison.
 
 **Real decode by default.** The reference's number includes real video
 decode through NVVL (reference models/r2p1d/model.py:140-151), so this
@@ -41,7 +46,7 @@ exit; an external SIGKILL on a TPU-attached process is what wedges the
 tunnel in the first place) — retrying with backoff within a time
 budget.
 
-Env knobs: RNB_BENCH_VIDEOS (default 2000: >10s measured window on
+Env knobs: RNB_BENCH_VIDEOS (default 4000: >10s measured window on
 TPU), RNB_BENCH_CONFIG, RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk),
 RNB_BENCH_DATASET (y4m|synth, default y4m), RNB_TPU_DATA_ROOT (use an
 existing dataset instead of generating), RNB_BENCH_PLATFORM (e.g.
@@ -158,10 +163,18 @@ def _dataset_spec():
     128 source frames so the sampler can place 15 non-overlapping
     8-frame clips (15*8=120 <= 128 keeps the reference's skewed [1,15]
     clip population intact), 192x256 source pixels so decode+resize
-    does real work per frame."""
+    does real work per frame. 4 labels x 11 videos is chosen because
+    the per-id deterministic sampler locks each file's clip count to
+    its path hash: this population lands at 4/44 large videos (9.1%)
+    and 2.27 clips/video on average — matching the [1,15]@[10,1]
+    weights the reference's sampler draws (a smaller set can skew to
+    ~3% large and flatter the measured throughput). The share holds for
+    the default data/bench_y4m root — ids are path-hashed, so custom
+    RNB_TPU_DATA_ROOT datasets carry their own (still deterministic)
+    mix."""
     e = os.environ.get
     return ("--labels", e("RNB_BENCH_DATASET_LABELS", "4"),
-            "--videos-per-label", e("RNB_BENCH_DATASET_VPL", "8"),
+            "--videos-per-label", e("RNB_BENCH_DATASET_VPL", "11"),
             "--frames", e("RNB_BENCH_DATASET_FRAMES", "128"),
             "--size", e("RNB_BENCH_DATASET_SIZE", "192x256"))
 
@@ -199,19 +212,42 @@ def _ensure_dataset(repo_dir: str):
     if mode != "y4m":
         raise ValueError("RNB_BENCH_DATASET must be y4m or synth, got %r"
                          % mode)
-    root = os.environ.get("RNB_TPU_DATA_ROOT") or os.path.join(
-        repo_dir, "data", "bench_y4m")
-    if _count_y4m(root) == 0:
-        sys.stderr.write("bench: generating y4m dataset under %s\n" % root)
+    user_root = os.environ.get("RNB_TPU_DATA_ROOT")
+    root = user_root or os.path.join(repo_dir, "data", "bench_y4m")
+    spec = list(_dataset_spec())
+    spec_path = os.path.join(root, "DATASET_SPEC.json")
+    spec_stale = False
+    if not user_root and _count_y4m(root) > 0:
+        # the generated cache is keyed by its spec: a geometry change
+        # (e.g. the round-4 clip-mix fix) must regenerate, or the run
+        # silently measures the old population while the evidence
+        # describes the new one. User-supplied roots are never touched.
+        try:
+            with open(spec_path) as f:
+                spec_stale = json.load(f) != spec
+        except (OSError, ValueError):
+            spec_stale = True
+    if _count_y4m(root) == 0 or spec_stale:
+        if spec_stale:
+            import shutil
+            sys.stderr.write("bench: regenerating %s (spec changed)\n"
+                             % root)
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            sys.stderr.write("bench: generating y4m dataset under %s\n"
+                             % root)
         subprocess.run(
             [sys.executable,
              os.path.join(repo_dir, "scripts", "make_dataset.py"),
-             "--root", root, *_dataset_spec()],
+             "--root", root, *spec],
             check=True, stdout=subprocess.DEVNULL)
         if _count_y4m(root) == 0:
             raise RuntimeError(
                 "dataset generation produced no root/label/*.y4m videos "
                 "under %s" % root)
+        if not user_root:
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)
     os.environ["RNB_TPU_DATA_ROOT"] = root
     from rnb_tpu.decode.native import native_available
     backend = "native-y4m" if native_available() else "numpy-y4m"
@@ -322,10 +358,10 @@ def main() -> int:
         if err:
             return _emit_error(err)
 
-    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "2000"))
+    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "4000"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",
-        os.path.join(repo_dir, "configs", "r2p1d-whole.json"))
+        os.path.join(repo_dir, "configs", "rnb-1chip.json"))
     mean_interval = int(os.environ.get("RNB_BENCH_MEAN_INTERVAL_MS", "0"))
 
     # the probe leaves one gap: the tunnel can wedge *between* the

@@ -120,11 +120,9 @@ class Batcher(StageModel):
     def _emit_fused(self):
         fused = []
         for pos, parts in enumerate(zip(*self._tensors)):
-            rows = np.concatenate(
-                [np.asarray(pb.data)[: pb.valid] for pb in parts], axis=0)
-            fused.append(PaddedBatch.from_rows(
-                rows, self._bucket_for(rows.shape[0],
-                                       self._declared_max[pos])))
+            valid = sum(pb.valid for pb in parts)
+            bucket = self._bucket_for(valid, self._declared_max[pos])
+            fused.append(self._fuse_parts(parts, valid, bucket))
 
         cards = TimeCardList(self._time_cards)
         self._tensors = []
@@ -133,6 +131,34 @@ class Batcher(StageModel):
         # None rather than one arbitrary constituent's non_tensors
         # (reference batcher.py:34 does the same).
         return tuple(fused), None, cards
+
+    @staticmethod
+    def _fuse_parts(parts, valid: int, bucket: int) -> PaddedBatch:
+        """Concatenate the valid rows of ``parts`` padded to ``bucket``.
+
+        Device arrays fuse ON DEVICE (lazy jnp slice+concat): the fused
+        batch never round-trips through the host, which matters doubly
+        on TPU — device_put/asarray bounces would serialize on transfer
+        latency, and the async concat lets the executor thread move on.
+        Host numpy payloads keep the numpy path.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        same_device = (
+            all(isinstance(pb.data, jax.Array) for pb in parts)
+            and len({d for pb in parts for d in pb.data.devices()}) == 1)
+        if same_device:
+            segments = [pb.data[: pb.valid] for pb in parts]
+            pad = bucket - valid
+            if pad > 0:
+                segments.append(jnp.zeros(
+                    (pad,) + tuple(parts[0].data.shape[1:]),
+                    parts[0].data.dtype))
+            return PaddedBatch(jnp.concatenate(segments, axis=0), valid)
+        rows = np.concatenate(
+            [np.asarray(pb.data)[: pb.valid] for pb in parts], axis=0)
+        return PaddedBatch.from_rows(rows, bucket)
 
     def flush(self):
         """End-of-stream hook (called by the executor on the exit

@@ -519,10 +519,14 @@ class R2P1DSingleStep(StageModel):
         return None
 
     def __call__(self, tensors, non_tensors, time_card):
+        import jax.numpy as jnp
         (pb,), _, time_card = self.loader(None, non_tensors, time_card)
         (logits,), _, time_card = self.net((pb,), None, time_card)
-        valid = np.asarray(logits.data)[: logits.valid]
-        pred = int(valid.sum(axis=0).argmax())
+        # sum+argmax on device; only the class id crosses to the host
+        # (a full logits D2H per video would serialize on transfer
+        # latency — painful through a remote-TPU tunnel)
+        pred = int(jnp.argmax(
+            jnp.sum(logits.data[: logits.valid], axis=0)))
         return None, pred, time_card
 
 

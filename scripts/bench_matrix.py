@@ -3,9 +3,13 @@
 The reference's methodology is the full config matrix driven at several
 mean intervals with per-config latency tables (reference
 README.md:176-185, config/*.json). This runner produces that table for
-this framework: each row is one (config, mean_interval) cell measured
-through bench.measure(), with throughput, p50/p99 latency, clip rate
-and MFU. Artifacts:
+this framework: each row is one (config, mean_interval) cell, measured
+by running ``bench.py`` in a FRESH subprocess — cells must not share a
+process, or earlier cells' backend/session state skews later ones
+(observed ~2x throughput loss for in-process back-to-back cells on the
+tunneled TPU). Each row is bench.py's one-line JSON verbatim.
+
+Artifacts:
 
 * ``BENCH_MATRIX.json`` — machine-readable rows + run metadata
 * ``MATRIX.md`` — the human table (committed for the judge)
@@ -14,26 +18,25 @@ Usage (TPU)::
 
     python scripts/bench_matrix.py
 
-Env: RNB_MATRIX_VIDEOS (default 2000; Poisson rows use 1/4 of it so a
-saturating arrival rate still finishes), RNB_MATRIX_MI (default 3 ms),
+Env: RNB_MATRIX_VIDEOS (default 4000; Poisson rows use 1/4 of it so a
+saturating arrival rate still finishes), RNB_MATRIX_MI (default 6 ms),
 RNB_MATRIX_OUT (artifact directory, default repo root),
-RNB_BENCH_PLATFORM / RNB_BENCH_DATASET as in bench.py.
+RNB_BENCH_PLATFORM / RNB_BENCH_DATASET forwarded to each cell.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
 
-import bench  # noqa: E402  (repo-root module)
 
-#: (config, mean_interval_ms) cells; 0 = bulk max-throughput
 def _cells(poisson_mi: int):
+    """(config, mean_interval_ms) cells; 0 = bulk max-throughput."""
     return [
         ("configs/r2p1d-whole.json", 0),
         ("configs/r2p1d-whole.json", poisson_mi),
@@ -43,53 +46,70 @@ def _cells(poisson_mi: int):
     ]
 
 
+def run_cell(config: str, mi: int, videos: int) -> dict:
+    """One fresh-process bench.py run; -> its JSON line as a dict."""
+    env = dict(os.environ)
+    env.update({
+        "RNB_BENCH_CONFIG": os.path.join(REPO, config),
+        "RNB_BENCH_MEAN_INTERVAL_MS": str(mi),
+        "RNB_BENCH_VIDEOS": str(videos),
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        return {"error": "bench.py produced no output (rc=%d): %s"
+                % (proc.returncode, proc.stderr[-300:])}
+    try:
+        row = json.loads(lines[-1])
+    except ValueError:
+        # a stray non-JSON line must cost this CELL, not the matrix —
+        # the other cells' measured TPU time is already spent
+        return {"error": "unparseable bench.py output (rc=%d): %r"
+                % (proc.returncode, lines[-1][:200])}
+    row["bench_rc"] = proc.returncode
+    return row
+
+
 def main() -> int:
-    videos = int(os.environ.get("RNB_MATRIX_VIDEOS", "2000"))
-    poisson_mi = int(os.environ.get("RNB_MATRIX_MI", "3"))
+    videos = int(os.environ.get("RNB_MATRIX_VIDEOS", "4000"))
+    poisson_mi = int(os.environ.get("RNB_MATRIX_MI", "6"))
     out_dir = os.environ.get("RNB_MATRIX_OUT", REPO)
     os.makedirs(out_dir, exist_ok=True)
 
-    decode_backend, dataset_root = bench._ensure_dataset(REPO)
-    platform = os.environ.get("RNB_BENCH_PLATFORM")
-    if platform:
-        import jax
-        jax.config.update("jax_platforms", platform)
-    else:
-        err = bench._probe_backend(
-            float(os.environ.get("RNB_BENCH_INIT_BUDGET_S", "600")),
-            float(os.environ.get("RNB_BENCH_PROBE_TIMEOUT_S", "90")))
-        if err:
-            print("matrix: %s" % err, file=sys.stderr)
-            return 1
-
     rows = []
+    backend_down = False
     for config, mi in _cells(poisson_mi):
         # Poisson cells run fewer videos: the arrival process adds idle
         # gaps, and the cell's job is the latency distribution, not a
         # long throughput window
         n = videos if mi == 0 else max(200, videos // 4)
+        if backend_down:
+            # don't burn a full probe budget per remaining cell once
+            # one cell established the backend is unreachable
+            rows.append({"config": config, "mean_interval_ms": mi,
+                         "num_videos": n,
+                         "error": "skipped: backend unavailable in an "
+                                  "earlier cell"})
+            continue
         print("matrix: %s mi=%d videos=%d ..." % (config, mi, n),
               file=sys.stderr)
         t0 = time.time()
-        try:
-            line, flag = bench.measure(
-                os.path.join(REPO, config), n, mi,
-                decode_backend, dataset_root,
-                log_base=os.path.join(REPO, "logs"))
-            line["termination_flag"] = int(flag)
-        except Exception as e:  # noqa: BLE001 — keep the rest of the matrix
-            line = {"config": config, "mean_interval_ms": mi,
-                    "num_videos": n, "error": "%s: %s"
-                    % (type(e).__name__, e)}
-        line["cell_wall_s"] = round(time.time() - t0, 1)
-        rows.append(line)
-        print("matrix:   -> %s" % json.dumps(line), file=sys.stderr)
+        row = run_cell(config, mi, n)
+        row.setdefault("config", config)
+        row.setdefault("mean_interval_ms", mi)
+        row["cell_wall_s"] = round(time.time() - t0, 1)
+        rows.append(row)
+        print("matrix:   -> %s" % json.dumps(row), file=sys.stderr)
+        if "backend unavailable" in str(row.get("error", "")):
+            backend_down = True
 
     artifact = {
         "rows": rows,
         "videos": videos,
         "poisson_mi": poisson_mi,
-        "decode_backend": decode_backend,
+        "isolation": "one fresh bench.py process per cell",
     }
     with open(os.path.join(out_dir, "BENCH_MATRIX.json"), "w") as f:
         json.dump(artifact, f, indent=2)
@@ -99,8 +119,10 @@ def main() -> int:
             "vs_baseline"]
     lines = ["# Benchmark matrix",
              "",
-             "decode_backend: `%s`  platform: `%s`" % (
-                 decode_backend, rows[0].get("platform", "?")),
+             "decode_backend: `%s`  platform: `%s`  device: `%s`" % (
+                 rows[0].get("decode_backend", "?"),
+                 rows[0].get("platform", "?"),
+                 rows[0].get("device_kind", "?")),
              "",
              "| " + " | ".join(cols) + " |",
              "|" + "---|" * len(cols)]
@@ -108,8 +130,9 @@ def main() -> int:
         lines.append("| " + " | ".join(
             str(row.get(c, "-")) for c in cols) + " |")
     lines.append("")
-    lines.append("Generated by scripts/bench_matrix.py; evidence keys "
-                 "match bench.py's headline JSON line.")
+    lines.append("Generated by scripts/bench_matrix.py (one fresh "
+                 "bench.py process per cell); row keys match bench.py's "
+                 "headline JSON line.")
     with open(os.path.join(out_dir, "MATRIX.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     print("matrix: wrote BENCH_MATRIX.json and MATRIX.md",

@@ -1,0 +1,101 @@
+"""Device-busy analysis of an ``--xprof`` capture.
+
+Cross-checks the analytic MFU published by bench.py against what the
+device trace says: reads a job's ``xprof-ops.txt`` (one ``t0_ns t1_ns
+op_name`` line per device-op interval, written by
+``rnb_tpu.benchmark --xprof``), merges overlapping intervals, and
+reports the busy fraction of the measured window plus the top ops by
+accumulated time.
+
+Usage::
+
+    python -m rnb_tpu.benchmark -c configs/r2p1d-whole.json -mi 0 \
+        -v 2000 --xprof
+    python scripts/device_busy.py logs/<job_id>/xprof-ops.txt
+
+An analytic MFU of X% with a device-busy fraction well above X% means
+the gap is kernel inefficiency (small batches, layout); busy fraction
+near X% means the chip is compute-bound and X% is the honest ceiling
+for this topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+
+
+def load_intervals(path: str):
+    """-> [(t0_ns, t1_ns, name)] from an xprof-ops.txt file."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split(" ", 2)
+            if len(parts) != 3:
+                continue
+            t0, t1, name = parts
+            out.append((int(t0), int(t1), name))
+    return out
+
+
+def merged_busy_ns(intervals) -> int:
+    """Union length of [t0, t1) intervals (overlaps counted once)."""
+    busy = 0
+    end = None
+    start = None
+    for t0, t1, _name in sorted(intervals):
+        if start is None:
+            start, end = t0, t1
+        elif t0 <= end:
+            end = max(end, t1)
+        else:
+            busy += end - start
+            start, end = t0, t1
+    if start is not None:
+        busy += end - start
+    return busy
+
+
+def summarize(intervals, top: int = 15):
+    if not intervals:
+        return {"ops": 0}
+    t_min = min(t0 for t0, _t1, _n in intervals)
+    t_max = max(t1 for _t0, t1, _n in intervals)
+    span = t_max - t_min
+    busy = merged_busy_ns(intervals)
+    per_op = defaultdict(int)
+    for t0, t1, name in intervals:
+        per_op[name] += t1 - t0
+    ranked = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "ops": len(intervals),
+        "span_ms": span / 1e6,
+        "busy_ms": busy / 1e6,
+        "busy_fraction": busy / span if span else 0.0,
+        "top_ops": ranked,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("trace", help="path to xprof-ops.txt")
+    parser.add_argument("--top", type=int, default=15)
+    args = parser.parse_args(argv)
+
+    stats = summarize(load_intervals(args.trace), args.top)
+    if not stats["ops"]:
+        print("no device-op intervals in %s" % args.trace)
+        return 1
+    print("device-op intervals : %d" % stats["ops"])
+    print("trace span          : %.3f ms" % stats["span_ms"])
+    print("device busy (union) : %.3f ms  (%.1f%% of span)"
+          % (stats["busy_ms"], 100.0 * stats["busy_fraction"]))
+    print("top ops by accumulated device time:")
+    for name, ns in stats["top_ops"]:
+        print("  %10.3f ms  %s" % (ns / 1e6, name[:90]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

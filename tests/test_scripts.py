@@ -101,3 +101,61 @@ def test_latency_summary_cli(tmp_path, capsys):
 def test_latency_summary_cli_empty(tmp_path):
     import latency_summary
     assert latency_summary.main(["--log-base", str(tmp_path)]) == 1
+
+
+def test_bench_matrix_short_circuits_on_backend_down(tmp_path,
+                                                     monkeypatch):
+    """One cell reporting 'backend unavailable' must skip the remaining
+    cells (no probe budget per cell) yet still write both artifacts
+    with every cell accounted for."""
+    import importlib
+    import json as _json
+    import os as _os
+
+    bench_matrix = importlib.import_module("bench_matrix")
+
+    calls = []
+
+    def fake_run_cell(config, mi, videos):
+        calls.append(config)
+        if len(calls) == 1:
+            return {"metric": "videos_per_sec", "value": 5.0,
+                    "config": config, "mean_interval_ms": mi,
+                    "num_videos": videos, "platform": "cpu",
+                    "decode_backend": "native-y4m"}
+        return {"config": config, "mean_interval_ms": mi,
+                "error": "backend unavailable after 3 probe(s)"}
+
+    monkeypatch.setattr(bench_matrix, "run_cell", fake_run_cell)
+    monkeypatch.setenv("RNB_MATRIX_OUT", str(tmp_path))
+    monkeypatch.setenv("RNB_MATRIX_VIDEOS", "8")
+    assert bench_matrix.main() == 0
+
+    # cell 3 flagged the backend down; cells 4-5 never ran
+    assert len(calls) == 2
+    artifact = _json.load(open(_os.path.join(str(tmp_path),
+                                             "BENCH_MATRIX.json")))
+    assert len(artifact["rows"]) == 5
+    skipped = [r for r in artifact["rows"]
+               if "skipped" in str(r.get("error", ""))]
+    assert len(skipped) == 3
+    table = open(_os.path.join(str(tmp_path), "MATRIX.md")).read()
+    assert table.count("|") > 10
+
+
+def test_bench_matrix_unparseable_cell_is_contained(monkeypatch,
+                                                    tmp_path):
+    """A cell whose bench.py prints garbage costs that cell only."""
+    import importlib
+    import subprocess as _sp
+
+    bench_matrix = importlib.import_module("bench_matrix")
+
+    class FakeProc:
+        returncode = 0
+        stdout = "not json at all\n"
+        stderr = ""
+
+    monkeypatch.setattr(_sp, "run", lambda *a, **k: FakeProc())
+    row = bench_matrix.run_cell("configs/x.json", 0, 4)
+    assert "unparseable" in row["error"]

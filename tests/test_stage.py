@@ -160,3 +160,31 @@ def test_batcher_flush_emits_partial_batch():
     np.testing.assert_array_equal(
         tensors[0].valid_data()[:, 0, 0, 0, 0], [1.0, 2.0])
     assert b.flush() is None  # state reset
+
+
+def test_batcher_fuses_on_device_without_host_bounce():
+    """Device-array constituents fuse into a device array on the same
+    device — the fused batch must not round-trip through the host
+    (through a TPU tunnel that bounce costs a transfer per request)."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[1]
+    b = Batcher(device=None, batch=2, row_buckets=[4, 15])
+
+    def dev_batch(n, fill):
+        data = jnp.full((n, 3, 8, 16, 16), fill, jnp.bfloat16)
+        data = jax.device_put(
+            jnp.concatenate([data, jnp.zeros((15 - n,) + data.shape[1:],
+                                             data.dtype)]), dev)
+        return (PaddedBatch(data, n),)
+
+    b(dev_batch(1, 1.0), None, TimeCard(0))
+    tensors, _, card = b(dev_batch(2, 2.0), None, TimeCard(1))
+    fused = tensors[0]
+    assert isinstance(fused.data, jax.Array)
+    assert fused.data.devices() == {dev}
+    assert fused.valid == 3
+    assert fused.data.shape[0] == 4  # padded to the bucket on device
+    got = np.asarray(fused.data[:, 0, 0, 0, 0], np.float32)
+    np.testing.assert_array_equal(got, [1.0, 2.0, 2.0, 0.0])
