@@ -133,13 +133,55 @@ inline unsigned char ClipByte(float v) {
 // Convert one source frame payload into the caller's RGB output tile,
 // fusing nearest chroma upsample + box resize (out[r][c] samples
 // source pixel (r*h/out_h, c*w/out_w) — the numpy backend's index map).
+// The column index maps are loop-invariant across rows (and frames of
+// the same geometry), so they are hoisted: the hot loop was paying a
+// 64-bit division per output pixel, which dominated decode on the
+// 1-core benchmark host.
 void ConvertFrame(const unsigned char* payload, const Y4mMeta& m,
-                  int out_w, int out_h, unsigned char* out) {
+                  int out_w, int out_h, unsigned char* out,
+                  std::vector<int>* col_map_storage) {
   const int w = m.width, h = m.height, sub = m.subsample;
   const int cw = w / sub;
   const unsigned char* yp = payload;
   const unsigned char* up = payload + static_cast<long long>(w) * h;
   const unsigned char* vp = up + static_cast<long long>(cw) * (h / sub);
+  // [0..out_w) luma column, [out_w..2*out_w) chroma column, then the
+  // 3-entry cache key (w, sub, out_w) — the map depends on all three,
+  // so geometry changes between calls rebuild instead of silently
+  // reusing stale indices
+  std::vector<int>& cols = *col_map_storage;
+  if (cols.size() != static_cast<size_t>(out_w) * 2 + 3 ||
+      cols[out_w * 2] != w || cols[out_w * 2 + 1] != sub ||
+      cols[out_w * 2 + 2] != out_w) {
+    cols.resize(static_cast<size_t>(out_w) * 2 + 3);
+    for (int c = 0; c < out_w; ++c) {
+      const int sx = static_cast<int>(
+          static_cast<long long>(c) * w / out_w);
+      cols[c] = sx;
+      cols[out_w + c] = sx / sub;
+    }
+    cols[out_w * 2] = w;
+    cols[out_w * 2 + 1] = sub;
+    cols[out_w * 2 + 2] = out_w;
+  }
+  const int* lcol = cols.data();
+  const int* ccol = cols.data() + out_w;
+  // chroma contributions depend only on the 8-bit sample: precompute
+  // the four products once (bit-identical to the inline multiplies,
+  // and the additions keep the numpy backend's left-to-right order so
+  // the two backends stay bit-exact)
+  static const struct ChromaLut {
+    float rv[256], gu[256], gv[256], bu[256];
+    ChromaLut() {
+      for (int i = 0; i < 256; ++i) {
+        const float f = static_cast<float>(i) - 128.0f;
+        rv[i] = 1.402f * f;
+        gu[i] = -0.344136f * f;
+        gv[i] = -0.714136f * f;
+        bu[i] = 1.772f * f;
+      }
+    }
+  } lut;
   for (int r = 0; r < out_h; ++r) {
     const int sy = static_cast<int>(
         static_cast<long long>(r) * h / out_h);
@@ -148,14 +190,12 @@ void ConvertFrame(const unsigned char* payload, const Y4mMeta& m,
     const unsigned char* vrow = vp + static_cast<long long>(sy / sub) * cw;
     unsigned char* orow = out + static_cast<long long>(r) * out_w * 3;
     for (int c = 0; c < out_w; ++c) {
-      const int sx = static_cast<int>(
-          static_cast<long long>(c) * w / out_w);
-      const float yf = static_cast<float>(yrow[sx]);
-      const float uf = static_cast<float>(urow[sx / sub]) - 128.0f;
-      const float vf = static_cast<float>(vrow[sx / sub]) - 128.0f;
-      orow[c * 3 + 0] = ClipByte(yf + 1.402f * vf);
-      orow[c * 3 + 1] = ClipByte(yf - 0.344136f * uf - 0.714136f * vf);
-      orow[c * 3 + 2] = ClipByte(yf + 1.772f * uf);
+      const float yf = static_cast<float>(yrow[lcol[c]]);
+      const unsigned char u = urow[ccol[c]];
+      const unsigned char v = vrow[ccol[c]];
+      orow[c * 3 + 0] = ClipByte(yf + lut.rv[v]);
+      orow[c * 3 + 1] = ClipByte((yf + lut.gu[u]) + lut.gv[v]);
+      orow[c * 3 + 2] = ClipByte(yf + lut.bu[u]);
     }
   }
 }
@@ -173,6 +213,7 @@ int DecodeClips(const char* path, const long long* clip_starts,
   if (!f) return kErrIo;
   std::vector<unsigned char> payload(
       static_cast<size_t>(m.frame_bytes));
+  std::vector<int> col_map;  // reused across every frame of this call
   const long long frame_out =
       static_cast<long long>(out_h) * out_w * 3;
   long long last_idx = -1;
@@ -195,7 +236,7 @@ int DecodeClips(const char* path, const long long* clip_starts,
           return kErrIo;
         }
         last_idx = idx;
-        ConvertFrame(payload.data(), m, out_w, out_h, dst);
+        ConvertFrame(payload.data(), m, out_w, out_h, dst, &col_map);
       } else {
         // consecutive repeats of the clamped last frame: copy the
         // previous converted output instead of re-decoding

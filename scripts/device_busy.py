@@ -26,7 +26,16 @@ import sys
 from collections import defaultdict
 
 
-def load_intervals(path: str):
+def is_device_op(name: str) -> bool:
+    """Heuristic: keep XLA/TPU op intervals, drop host-side trace rows
+    (python frames like ``$threading.py:323 wait``, thread bootstrap
+    spans) that the xplane capture interleaves on CPU backends —
+    counting those as 'busy' would claim 100% trivially."""
+    return not (name.startswith("$") or ".py" in name
+                or name.startswith("Thread "))
+
+
+def load_intervals(path: str, device_only: bool = True):
     """-> [(t0_ns, t1_ns, name)] from an xprof-ops.txt file."""
     out = []
     with open(path) as f:
@@ -35,6 +44,8 @@ def load_intervals(path: str):
             if len(parts) != 3:
                 continue
             t0, t1, name = parts
+            if device_only and not is_device_op(name):
+                continue
             out.append((int(t0), int(t1), name))
     return out
 
@@ -57,11 +68,17 @@ def merged_busy_ns(intervals) -> int:
     return busy
 
 
-def summarize(intervals, top: int = 15):
+def summarize(intervals, top: int = 15, span_bounds=None):
+    """``span_bounds`` (t_min, t_max) should come from the UNFILTERED
+    trace: device idle at the window's edges must stay in the
+    denominator, or the busy fraction overstates utilization."""
     if not intervals:
         return {"ops": 0}
-    t_min = min(t0 for t0, _t1, _n in intervals)
-    t_max = max(t1 for _t0, t1, _n in intervals)
+    if span_bounds is not None:
+        t_min, t_max = span_bounds
+    else:
+        t_min = min(t0 for t0, _t1, _n in intervals)
+        t_max = max(t1 for _t0, t1, _n in intervals)
     span = t_max - t_min
     busy = merged_busy_ns(intervals)
     per_op = defaultdict(int)
@@ -81,9 +98,20 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("trace", help="path to xprof-ops.txt")
     parser.add_argument("--top", type=int, default=15)
+    parser.add_argument("--include-host", action="store_true",
+                        help="keep host-side python/thread trace rows")
     args = parser.parse_args(argv)
 
-    stats = summarize(load_intervals(args.trace), args.top)
+    everything = load_intervals(args.trace, device_only=False)
+    if not everything:
+        print("no intervals in %s" % args.trace)
+        return 1
+    bounds = (min(t0 for t0, _t1, _n in everything),
+              max(t1 for _t0, t1, _n in everything))
+    stats = summarize(
+        load_intervals(args.trace,
+                       device_only=not args.include_host),
+        args.top, span_bounds=bounds)
     if not stats["ops"]:
         print("no device-op intervals in %s" % args.trace)
         return 1

@@ -159,3 +159,28 @@ def test_bench_matrix_unparseable_cell_is_contained(monkeypatch,
     monkeypatch.setattr(_sp, "run", lambda *a, **k: FakeProc())
     row = bench_matrix.run_cell("configs/x.json", 0, 4)
     assert "unparseable" in row["error"]
+
+
+def test_device_busy_union_and_filter(tmp_path):
+    import device_busy
+
+    trace = tmp_path / "xprof-ops.txt"
+    trace.write_text(
+        "0 100 fusion.1\n"
+        "50 150 convolution.2\n"          # overlaps fusion.1
+        "300 400 copy.3\n"
+        "0 1000 $threading.py:323 wait\n"  # host row: filtered out
+        "0 900 Thread #7\n")
+    ivals = device_busy.load_intervals(str(trace))
+    assert len(ivals) == 3
+    # union: [0,150) + [300,400) = 250 ns busy; the span denominator
+    # comes from the UNFILTERED trace (the host row spans [0,1000)) so
+    # device idle at the window's edges is not hidden
+    stats = device_busy.summarize(ivals, span_bounds=(0, 1000))
+    assert stats["busy_ms"] == 250 / 1e6
+    assert stats["span_ms"] == 1000 / 1e6
+    assert abs(stats["busy_fraction"] - 0.25) < 1e-9
+    # host rows kept on demand
+    assert len(device_busy.load_intervals(str(trace),
+                                          device_only=False)) == 5
+    assert device_busy.main([str(trace)]) == 0
