@@ -43,7 +43,15 @@ def _cells(poisson_mi: int):
         ("configs/rnb-1chip.json", 0),
         ("configs/rnb-1chip.json", poisson_mi),
         ("configs/r2p1d-nopipeline-1chip.json", 0),
+        ("configs/r2p1d-split-1chip.json", 0),
     ]
+
+
+# the fused single-stage baseline serializes decode -> transfer ->
+# compute per request (~5 videos/s through the tunnel); a full-length
+# cell would burn ~13 min of TPU time to prove a collapse 300 videos
+# already show with a ~60 s window
+SLOW_CONFIGS = {"configs/r2p1d-nopipeline-1chip.json": 300}
 
 
 def run_cell(config: str, mi: int, videos: int) -> dict:
@@ -85,6 +93,7 @@ def main() -> int:
         # gaps, and the cell's job is the latency distribution, not a
         # long throughput window
         n = videos if mi == 0 else max(200, videos // 4)
+        n = min(n, SLOW_CONFIGS.get(config, n))
         if backend_down:
             # don't burn a full probe budget per remaining cell once
             # one cell established the backend is unreachable

@@ -135,10 +135,10 @@ def test_bench_matrix_short_circuits_on_backend_down(tmp_path,
     assert len(calls) == 2
     artifact = _json.load(open(_os.path.join(str(tmp_path),
                                              "BENCH_MATRIX.json")))
-    assert len(artifact["rows"]) == 5
+    assert len(artifact["rows"]) == len(bench_matrix._cells(6))
     skipped = [r for r in artifact["rows"]
                if "skipped" in str(r.get("error", ""))]
-    assert len(skipped) == 3
+    assert len(skipped) == len(artifact["rows"]) - 2
     table = open(_os.path.join(str(tmp_path), "MATRIX.md")).read()
     assert table.count("|") > 10
 
