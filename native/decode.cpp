@@ -200,12 +200,82 @@ void ConvertFrame(const unsigned char* payload, const Y4mMeta& m,
   }
 }
 
+// Gather one source frame into packed output-resolution 4:2:0 planes:
+// Y (out_h x out_w) then U, V (out_h/2 x out_w/2 each), concatenated.
+// No float math happens on the host in this pixel path — chroma
+// upsample + BT.601 conversion run on the accelerator, fused into the
+// ingest preprocess (rnb_tpu/ops/yuv.py). Luma uses the same
+// nearest-neighbour index map as ConvertFrame (bit-exact with the RGB
+// path); chroma keeps its own nearest map at half output resolution,
+// the standard 4:2:0 semantics.
+void GatherFrameYUV(const unsigned char* payload, const Y4mMeta& m,
+                    int out_w, int out_h, unsigned char* out,
+                    std::vector<int>* col_map_storage) {
+  const int w = m.width, h = m.height, sub = m.subsample;
+  const int cw = w / sub, ch = h / sub;
+  const int half_w = out_w / 2, half_h = out_h / 2;
+  const unsigned char* yp = payload;
+  const unsigned char* up = payload + static_cast<long long>(w) * h;
+  const unsigned char* vp = up + static_cast<long long>(cw) * ch;
+  // [0..out_w) luma column map, [out_w..out_w+half_w) chroma column
+  // map (against the source chroma plane), then the cache key — one
+  // extra sentinel vs the RGB path's key so the two layouts can never
+  // alias in a shared storage vector
+  std::vector<int>& cols = *col_map_storage;
+  const size_t want = static_cast<size_t>(out_w) + half_w + 4;
+  if (cols.size() != want || cols[out_w + half_w] != w ||
+      cols[out_w + half_w + 1] != sub ||
+      cols[out_w + half_w + 2] != out_w ||
+      cols[out_w + half_w + 3] != -2) {
+    cols.resize(want);
+    for (int c = 0; c < out_w; ++c)
+      cols[c] = static_cast<int>(static_cast<long long>(c) * w / out_w);
+    for (int c = 0; c < half_w; ++c)
+      cols[out_w + c] =
+          static_cast<int>(static_cast<long long>(c) * cw / half_w);
+    cols[out_w + half_w] = w;
+    cols[out_w + half_w + 1] = sub;
+    cols[out_w + half_w + 2] = out_w;
+    cols[out_w + half_w + 3] = -2;
+  }
+  const int* lcol = cols.data();
+  const int* ccol = cols.data() + out_w;
+  unsigned char* oy = out;
+  unsigned char* ou = out + static_cast<long long>(out_h) * out_w;
+  unsigned char* ov = ou + static_cast<long long>(half_h) * half_w;
+  for (int r = 0; r < out_h; ++r) {
+    const int sy = static_cast<int>(
+        static_cast<long long>(r) * h / out_h);
+    const unsigned char* yrow = yp + static_cast<long long>(sy) * w;
+    unsigned char* orow = oy + static_cast<long long>(r) * out_w;
+    for (int c = 0; c < out_w; ++c) orow[c] = yrow[lcol[c]];
+  }
+  for (int r = 0; r < half_h; ++r) {
+    const int sy = static_cast<int>(
+        static_cast<long long>(r) * ch / half_h);
+    const unsigned char* urow = up + static_cast<long long>(sy) * cw;
+    const unsigned char* vrow = vp + static_cast<long long>(sy) * cw;
+    unsigned char* our = ou + static_cast<long long>(r) * half_w;
+    unsigned char* ovr = ov + static_cast<long long>(r) * half_w;
+    for (int c = 0; c < half_w; ++c) {
+      our[c] = urow[ccol[c]];
+      ovr[c] = vrow[ccol[c]];
+    }
+  }
+}
+
+constexpr int kPixRgb = 0;     // fused convert+resize, RGB u8 out
+constexpr int kPixYuv420 = 1;  // gather-only, packed 4:2:0 planes out
+
 int DecodeClips(const char* path, const long long* clip_starts,
                 int num_clips, int consecutive, int out_w, int out_h,
-                unsigned char* out) {
+                unsigned char* out, int pixfmt = kPixRgb) {
   if (num_clips < 0 || consecutive <= 0 || out_w <= 0 || out_h <= 0 ||
       out == nullptr)
     return kErrArg;
+  if (pixfmt != kPixRgb && pixfmt != kPixYuv420) return kErrArg;
+  if (pixfmt == kPixYuv420 && (out_w % 2 != 0 || out_h % 2 != 0))
+    return kErrArg;  // packed 4:2:0 needs even output geometry
   Y4mMeta m;
   int rc = ProbeFile(path, &m);
   if (rc != 0) return rc;
@@ -215,7 +285,9 @@ int DecodeClips(const char* path, const long long* clip_starts,
       static_cast<size_t>(m.frame_bytes));
   std::vector<int> col_map;  // reused across every frame of this call
   const long long frame_out =
-      static_cast<long long>(out_h) * out_w * 3;
+      pixfmt == kPixYuv420
+          ? static_cast<long long>(out_h) * out_w * 3 / 2
+          : static_cast<long long>(out_h) * out_w * 3;
   long long last_idx = -1;
   for (int ci = 0; ci < num_clips; ++ci) {
     if (clip_starts[ci] < 0) {
@@ -236,7 +308,10 @@ int DecodeClips(const char* path, const long long* clip_starts,
           return kErrIo;
         }
         last_idx = idx;
-        ConvertFrame(payload.data(), m, out_w, out_h, dst, &col_map);
+        if (pixfmt == kPixYuv420)
+          GatherFrameYUV(payload.data(), m, out_w, out_h, dst, &col_map);
+        else
+          ConvertFrame(payload.data(), m, out_w, out_h, dst, &col_map);
       } else {
         // consecutive repeats of the clamped last frame: copy the
         // previous converted output instead of re-decoding
@@ -256,6 +331,7 @@ struct Job {
   std::string path;
   std::vector<long long> starts;
   int consecutive, out_w, out_h;
+  int pixfmt = kPixRgb;
   unsigned char* out;
 };
 
@@ -286,7 +362,7 @@ struct Pool {
       const int rc = DecodeClips(
           job.path.c_str(), job.starts.data(),
           static_cast<int>(job.starts.size()), job.consecutive,
-          job.out_w, job.out_h, job.out);
+          job.out_w, job.out_h, job.out, job.pixfmt);
       {
         std::lock_guard<std::mutex> lk(mu);
         done[job.ticket] = rc;
@@ -347,6 +423,16 @@ int rnb_y4m_decode_clips(const char* path, const long long* clip_starts,
                      out_h, out);
 }
 
+// pixfmt: 0 = RGB (fused convert+resize), 1 = packed 4:2:0 planes
+// (gather-only; out gets out_h*out_w*3/2 bytes per frame).
+int rnb_y4m_decode_clips_fmt(const char* path,
+                             const long long* clip_starts, int num_clips,
+                             int consecutive, int out_w, int out_h,
+                             int pixfmt, unsigned char* out) {
+  return DecodeClips(path, clip_starts, num_clips, consecutive, out_w,
+                     out_h, out, pixfmt);
+}
+
 void* rnb_pool_create(int num_threads) {
   if (num_threads <= 0) num_threads = 1;
   return new Pool(num_threads);
@@ -365,6 +451,24 @@ long long rnb_pool_submit(void* pool, const char* path,
   job.consecutive = consecutive;
   job.out_w = out_w;
   job.out_h = out_h;
+  job.out = out;
+  return static_cast<Pool*>(pool)->Submit(std::move(job));
+}
+
+long long rnb_pool_submit_fmt(void* pool, const char* path,
+                              const long long* clip_starts,
+                              int num_clips, int consecutive, int out_w,
+                              int out_h, int pixfmt,
+                              unsigned char* out) {
+  if (!pool || num_clips < 0) return -1;
+  if (pixfmt != kPixRgb && pixfmt != kPixYuv420) return -1;
+  Job job;
+  job.path = path;
+  job.starts.assign(clip_starts, clip_starts + num_clips);
+  job.consecutive = consecutive;
+  job.out_w = out_w;
+  job.out_h = out_h;
+  job.pixfmt = pixfmt;
   job.out = out;
   return static_cast<Pool*>(pool)->Submit(std::move(job));
 }

@@ -46,6 +46,16 @@ class VideoDecoder:
         """-> uint8 (num_clips, consecutive_frames, height, width, 3)."""
         raise NotImplementedError
 
+    def decode_clips_yuv(self, video: str, clip_starts: List[int],
+                         consecutive_frames: int = 8,
+                         width: int = DEFAULT_WIDTH,
+                         height: int = DEFAULT_HEIGHT) -> np.ndarray:
+        """-> uint8 (num_clips, consecutive_frames, H*W*3//2): packed
+        output-resolution 4:2:0 planes (Y then U then V per frame) for
+        the on-device colourspace path (rnb_tpu/ops/yuv.py). Geometry
+        must be even."""
+        raise NotImplementedError
+
 
 class SyntheticDecoder(VideoDecoder):
     """Procedural frames, deterministic per (video id, clip start).
@@ -72,6 +82,21 @@ class SyntheticDecoder(VideoDecoder):
             rng = np.random.default_rng(seed)
             out[i] = rng.integers(0, 256,
                                   (consecutive_frames, height, width, 3),
+                                  dtype=np.uint8)
+        return out
+
+    def decode_clips_yuv(self, video, clip_starts, consecutive_frames=8,
+                         width=DEFAULT_WIDTH, height=DEFAULT_HEIGHT):
+        if width % 2 or height % 2:
+            raise ValueError("packed 4:2:0 needs even geometry")
+        packed = height * width * 3 // 2
+        out = np.empty((len(clip_starts), consecutive_frames, packed),
+                       dtype=np.uint8)
+        for i, start in enumerate(clip_starts):
+            # distinct PRNG stream from the rgb path (different label)
+            seed = zlib.crc32(("yuv:%s@%d" % (video, start)).encode())
+            rng = np.random.default_rng(seed)
+            out[i] = rng.integers(0, 256, (consecutive_frames, packed),
                                   dtype=np.uint8)
         return out
 
@@ -180,6 +205,56 @@ class Y4MDecoder(VideoDecoder):
                            + meta["marker_len"])
                     frame = self._read_frame(f, meta)
                     out[ci, fi] = self._box_resize(frame, width, height)
+        return out
+
+    @staticmethod
+    def _gather_frame_yuv(payload, meta, maps) -> np.ndarray:
+        """One frame payload -> packed output-res 4:2:0 planes.
+
+        Pure gathers, no float math: luma uses the rgb path's exact
+        nearest index map; chroma keeps its own nearest map at half
+        output resolution (rnb_tpu/ops/yuv.py docstring). Mirrors the
+        native GatherFrameYUV bit-exactly (native/decode.cpp).
+        """
+        w, h, sub = meta["width"], meta["height"], meta["subsample"]
+        cw, ch = w // sub, h // sub
+        rows, cols, crows, ccols = maps
+        y = np.frombuffer(payload, np.uint8, w * h).reshape(h, w)
+        u = np.frombuffer(payload, np.uint8, cw * ch,
+                          offset=w * h).reshape(ch, cw)
+        v = np.frombuffer(payload, np.uint8, cw * ch,
+                          offset=w * h + cw * ch).reshape(ch, cw)
+        return np.concatenate([
+            y[rows][:, cols].ravel(),
+            u[crows][:, ccols].ravel(),
+            v[crows][:, ccols].ravel(),
+        ])
+
+    def decode_clips_yuv(self, video, clip_starts, consecutive_frames=8,
+                         width=DEFAULT_WIDTH, height=DEFAULT_HEIGHT):
+        if width % 2 or height % 2:
+            raise ValueError("packed 4:2:0 needs even geometry")
+        meta = self._parse_header(video)
+        if any(s < 0 for s in clip_starts):
+            raise ValueError("negative clip start in %r" % (clip_starts,))
+        packed = height * width * 3 // 2
+        out = np.empty((len(clip_starts), consecutive_frames, packed),
+                       dtype=np.uint8)
+        # the index maps are invariant per (geometry) — hoisted out of
+        # the frame loop, as in the native decoder
+        w, h, sub = meta["width"], meta["height"], meta["subsample"]
+        maps = (np.arange(height) * h // height,
+                np.arange(width) * w // width,
+                np.arange(height // 2) * (h // sub) // (height // 2),
+                np.arange(width // 2) * (w // sub) // (width // 2))
+        with open(video, "rb") as f:
+            for ci, start in enumerate(clip_starts):
+                for fi in range(consecutive_frames):
+                    idx = min(start + fi, meta["count"] - 1)
+                    f.seek(meta["data_start"] + idx * meta["stride"]
+                           + meta["marker_len"])
+                    out[ci, fi] = self._gather_frame_yuv(
+                        f.read(meta["frame_bytes"]), meta, maps)
         return out
 
 

@@ -26,6 +26,10 @@ _ERR_MSGS = {
     -4: "bad argument",
 }
 
+#: pixel formats of the native decoder (native/decode.cpp kPix*)
+PIX_RGB = 0       # fused convert+resize -> (n, F, H, W, 3) u8
+PIX_YUV420 = 1    # gather-only packed planes -> (n, F, H*W*3//2) u8
+
 _lib = None
 _lib_checked = False
 _lib_lock = threading.Lock()
@@ -56,6 +60,14 @@ def load_native():
             lib = ctypes.CDLL(path)
         except OSError:
             return None
+        # a stale prebuilt library missing newer exports must degrade
+        # to the numpy backend like a missing library, not crash
+        for sym in ("rnb_y4m_probe", "rnb_y4m_decode_clips",
+                    "rnb_y4m_decode_clips_fmt", "rnb_pool_create",
+                    "rnb_pool_destroy", "rnb_pool_submit",
+                    "rnb_pool_submit_fmt", "rnb_pool_wait"):
+            if not hasattr(lib, sym):
+                return None
         lib.rnb_y4m_probe.restype = ctypes.c_int
         lib.rnb_y4m_probe.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
@@ -76,6 +88,16 @@ def load_native():
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
         lib.rnb_pool_wait.restype = ctypes.c_int
         lib.rnb_pool_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.rnb_y4m_decode_clips_fmt.restype = ctypes.c_int
+        lib.rnb_y4m_decode_clips_fmt.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_char_p]
+        lib.rnb_pool_submit_fmt.restype = ctypes.c_longlong
+        lib.rnb_pool_submit_fmt.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
         _lib = lib
         return _lib
 
@@ -136,22 +158,40 @@ class DecodePool:
         return ticket, out
 
     def submit_into(self, path: str, clip_starts: List[int],
-                    consecutive_frames: int, out: np.ndarray) -> int:
-        """Decode into a caller-provided C-contiguous uint8 view of
-        shape (len(clip_starts), consecutive_frames, H, W, 3) — lets
+                    consecutive_frames: int, out: np.ndarray,
+                    pixfmt: int = PIX_RGB,
+                    width: int = DEFAULT_WIDTH,
+                    height: int = DEFAULT_HEIGHT) -> int:
+        """Decode into a caller-provided C-contiguous uint8 view —
+        (clips, frames, H, W, 3) for PIX_RGB, (clips, frames, H*W*3//2)
+        packed planes for PIX_YUV420 (geometry comes from
+        width/height there; a packed length alone is ambiguous). Lets
         one logical decode fan out over the pool by submitting chunks
         that target disjoint slices of a single batch buffer."""
-        if (out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"]
+        if out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"] \
                 or out.shape[:2] != (len(clip_starts),
-                                     consecutive_frames)
-                or out.ndim != 5 or out.shape[4] != 3):
+                                     consecutive_frames):
             raise ValueError("bad output buffer %r for %d clips x %d "
                              "frames" % (out.shape, len(clip_starts),
                                          consecutive_frames))
+        if pixfmt == PIX_RGB:
+            if out.ndim != 5 or out.shape[4] != 3:
+                raise ValueError("PIX_RGB wants (clips, frames, H, W, 3)"
+                                 ", got %r" % (out.shape,))
+            out_w, out_h = out.shape[3], out.shape[2]
+        elif pixfmt == PIX_YUV420:
+            if out.ndim != 3 or out.shape[2] != height * width * 3 // 2:
+                raise ValueError(
+                    "PIX_YUV420 wants (clips, frames, %d) for %dx%d, "
+                    "got %r" % (height * width * 3 // 2, height, width,
+                                out.shape))
+            out_w, out_h = width, height
+        else:
+            raise ValueError("unknown pixfmt %r" % (pixfmt,))
         starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
-        ticket = self._lib.rnb_pool_submit(
+        ticket = self._lib.rnb_pool_submit_fmt(
             self._pool, path.encode(), starts, len(clip_starts),
-            consecutive_frames, out.shape[3], out.shape[2],
+            consecutive_frames, out_w, out_h, pixfmt,
             out.ctypes.data_as(ctypes.c_char_p))
         if ticket <= 0:
             raise RuntimeError("native pool rejected submit for %r" % path)
@@ -212,6 +252,34 @@ class NativeY4MDecoder(VideoDecoder):
             self._count_cache[video] = int(n.value)
         return self._count_cache[video]
 
+    def _pool_fanout(self, video: str, clip_starts: List[int],
+                     consecutive_frames: int, out: np.ndarray,
+                     pixfmt: int, width: int, height: int) -> np.ndarray:
+        """Split one logical decode into per-chunk pool tickets writing
+        disjoint slices of ``out``; retire EVERY submitted ticket even
+        if one fails — un-waited tickets would pin the batch buffer in
+        _pending and leak done-map entries in the native pool."""
+        pool = DecodePool.shared()
+        chunk = max(1, -(-len(clip_starts) // pool.num_threads))
+        tickets = []
+        first_error = None
+        try:
+            for lo in range(0, len(clip_starts), chunk):
+                hi = min(lo + chunk, len(clip_starts))
+                tickets.append(pool.submit_into(
+                    video, clip_starts[lo:hi], consecutive_frames,
+                    out[lo:hi], pixfmt=pixfmt, width=width,
+                    height=height))
+        finally:
+            for ticket in tickets:
+                try:
+                    pool.wait(ticket, video)
+                except ValueError as e:
+                    first_error = first_error or e
+        if first_error is not None:
+            raise first_error
+        return out
+
     def decode_clips(self, video: str, clip_starts: List[int],
                      consecutive_frames: int = 8,
                      width: int = DEFAULT_WIDTH,
@@ -219,30 +287,30 @@ class NativeY4MDecoder(VideoDecoder):
         out = np.empty((len(clip_starts), consecutive_frames, height,
                         width, 3), dtype=np.uint8)
         if self._use_pool and len(clip_starts) >= POOL_SPLIT_MIN_CLIPS:
-            pool = DecodePool.shared()
-            chunk = max(1, -(-len(clip_starts) // pool.num_threads))
-            tickets = []
-            first_error = None
-            try:
-                for lo in range(0, len(clip_starts), chunk):
-                    hi = min(lo + chunk, len(clip_starts))
-                    tickets.append(pool.submit_into(
-                        video, clip_starts[lo:hi], consecutive_frames,
-                        out[lo:hi]))
-            finally:
-                # retire EVERY submitted ticket even if one fails —
-                # un-waited tickets would pin the batch buffer in
-                # _pending and leak done-map entries in the native pool
-                for ticket in tickets:
-                    try:
-                        pool.wait(ticket, video)
-                    except ValueError as e:
-                        first_error = first_error or e
-            if first_error is not None:
-                raise first_error
-            return out
+            return self._pool_fanout(video, clip_starts,
+                                     consecutive_frames, out, PIX_RGB,
+                                     width, height)
         starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
         _check(self._lib.rnb_y4m_decode_clips(
             video.encode(), starts, len(clip_starts), consecutive_frames,
             width, height, out.ctypes.data_as(ctypes.c_char_p)), video)
+        return out
+
+    def decode_clips_yuv(self, video: str, clip_starts: List[int],
+                         consecutive_frames: int = 8,
+                         width: int = DEFAULT_WIDTH,
+                         height: int = DEFAULT_HEIGHT) -> np.ndarray:
+        if width % 2 or height % 2:
+            raise ValueError("packed 4:2:0 needs even geometry")
+        out = np.empty((len(clip_starts), consecutive_frames,
+                        height * width * 3 // 2), dtype=np.uint8)
+        if self._use_pool and len(clip_starts) >= POOL_SPLIT_MIN_CLIPS:
+            return self._pool_fanout(video, clip_starts,
+                                     consecutive_frames, out,
+                                     PIX_YUV420, width, height)
+        starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
+        _check(self._lib.rnb_y4m_decode_clips_fmt(
+            video.encode(), starts, len(clip_starts), consecutive_frames,
+            width, height, PIX_YUV420,
+            out.ctypes.data_as(ctypes.c_char_p)), video)
         return out

@@ -58,9 +58,17 @@ _normalize_row_buckets = normalize_row_buckets
 
 
 def _shared_apply(start: int, end: int, num_classes: int,
-                  layer_sizes: tuple, factored_shortcut: bool = False):
-    """One jitted inference applier shared by every replica of a range."""
-    key = (start, end, num_classes, layer_sizes, factored_shortcut)
+                  layer_sizes: tuple, factored_shortcut: bool = False,
+                  pixel_path: str = "rgb"):
+    """One jitted inference applier shared by every replica of a range.
+
+    ``pixel_path="yuv420"`` (layer-1 stages only) prepends the fused
+    ingest — packed 4:2:0 planes -> chroma upsample -> BT.601 ->
+    normalize (rnb_tpu/ops/yuv.py) — inside the same jit, so XLA fuses
+    the colourspace math with the first convolution's input pipeline.
+    """
+    key = (start, end, num_classes, layer_sizes, factored_shortcut,
+           pixel_path)
     with _cache_lock:
         fn = _apply_cache.get(key)
         if fn is None:
@@ -70,8 +78,15 @@ def _shared_apply(start: int, end: int, num_classes: int,
                                        layer_sizes=layer_sizes,
                                        factored_shortcut=factored_shortcut)
 
-            def apply(variables, x):
-                return model.apply(variables, x, train=False)
+            if pixel_path == "yuv420":
+                from rnb_tpu.ops.yuv import normalize_yuv420
+
+                def apply(variables, x):
+                    return model.apply(variables, normalize_yuv420(
+                        x, FRAME_HW, FRAME_HW), train=False)
+            else:
+                def apply(variables, x):
+                    return model.apply(variables, x, train=False)
 
             fn = jax.jit(apply)
             _apply_cache[key] = fn
@@ -165,7 +180,8 @@ class R2P1DLoader(StageModel):
                  num_clips_population=None, weights=None,
                  num_warmups: int = NUM_WARMUPS,
                  raw_output: bool = False,
-                 row_buckets=None, prefetch: int = 0, **kwargs):
+                 row_buckets=None, prefetch: int = 0,
+                 pixel_path: str = "rgb", **kwargs):
         super().__init__(device)
         import jax
         self._jax_device = _resolve(device)
@@ -173,6 +189,20 @@ class R2P1DLoader(StageModel):
         #: of bf16 on the wire) for consumers that normalize on their
         #: own mesh, e.g. R2P1DMeshRunner
         self.raw_output = bool(raw_output)
+        # "yuv420": host decode stops at packed output-res 4:2:0 planes
+        # (pure gathers, 1.5 bytes/pixel on the wire); the consuming
+        # network stage fuses upsample+BT.601+normalize into its jit
+        # (rnb_tpu/ops/yuv.py). The benchmark host's single core is the
+        # throughput ceiling (RESULTS.md), so moving the colourspace
+        # arithmetic on-device lifts end-to-end throughput directly.
+        if pixel_path not in ("rgb", "yuv420"):
+            raise ValueError("pixel_path must be 'rgb' or 'yuv420', "
+                             "got %r" % (pixel_path,))
+        if pixel_path == "yuv420" and raw_output:
+            raise ValueError("raw_output consumers (mesh stages) "
+                             "normalize rgb frames; combine with "
+                             "pixel_path='yuv420' is not supported")
+        self.pixel_path = pixel_path
         sampler_kwargs = {}
         if num_clips_population is not None:
             sampler_kwargs["num_clips_population"] = num_clips_population
@@ -200,8 +230,17 @@ class R2P1DLoader(StageModel):
                              "clip axis")
         self.prefetch_depth = int(prefetch)
         self._fallback_pool = None  # lazily built thread pool
-        if self.raw_output:
-            self._preprocess = None  # consumer normalizes on its mesh
+        if self.raw_output or self.pixel_path == "yuv420":
+            # raw mode: consumer normalizes on its mesh. yuv420: the
+            # network stage's jit owns the whole ingest; the loader
+            # ships packed u8 — warm only the transfer path per bucket
+            self._preprocess = None
+            for bucket in self.row_buckets:
+                dummy = np.zeros(self._batch_shape(bucket),
+                                 dtype=np.uint8)
+                for _ in range(num_warmups):
+                    jax.block_until_ready(
+                        jax.device_put(dummy, self._jax_device))
         else:
             self._preprocess = _shared_preprocess(self._jax_device)
             # warm-up: compile the preprocess for every bucket shape and
@@ -240,12 +279,26 @@ class R2P1DLoader(StageModel):
             length = decoder.num_frames(path)
             starts = self.sampler.sample(length,
                                          video_id=path)[: self.max_clips]
-            decoder.decode_clips(path, starts, self.consecutive_frames,
-                                 width=FRAME_HW, height=FRAME_HW)
+            self._decode_sync(decoder, path, starts)
+
+    def _decode_sync(self, decoder, video, starts):
+        """Synchronous decode through this loader's pixel path."""
+        if self.pixel_path == "yuv420":
+            return decoder.decode_clips_yuv(video, starts,
+                                            self.consecutive_frames,
+                                            width=FRAME_HW,
+                                            height=FRAME_HW)
+        return decoder.decode_clips(video, starts,
+                                    self.consecutive_frames,
+                                    width=FRAME_HW, height=FRAME_HW)
 
     def _batch_shape(self, rows: Optional[int] = None):
-        return (rows if rows is not None else self.max_clips,
-                self.consecutive_frames, FRAME_HW, FRAME_HW, 3)
+        n = rows if rows is not None else self.max_clips
+        if self.pixel_path == "yuv420":
+            from rnb_tpu.ops.yuv import packed_frame_bytes
+            return (n, self.consecutive_frames,
+                    packed_frame_bytes(FRAME_HW, FRAME_HW))
+        return (n, self.consecutive_frames, FRAME_HW, FRAME_HW, 3)
 
     def _bucket_for(self, n: int) -> int:
         for bucket in self.row_buckets:
@@ -263,7 +316,11 @@ class R2P1DLoader(StageModel):
     @classmethod
     def output_shape_for(cls, max_clips: int = MAX_CLIPS,
                          consecutive_frames: int = CONSECUTIVE_FRAMES,
-                         **_kwargs):
+                         pixel_path: str = "rgb", **_kwargs):
+        if pixel_path == "yuv420":
+            from rnb_tpu.ops.yuv import packed_frame_bytes
+            return ((int(max_clips), int(consecutive_frames),
+                     packed_frame_bytes(FRAME_HW, FRAME_HW)),)
         return ((int(max_clips), int(consecutive_frames),
                  FRAME_HW, FRAME_HW, 3),)
 
@@ -292,10 +349,12 @@ class R2P1DLoader(StageModel):
         # vanished resolves to SyntheticDecoder there, and submitting it
         # to the native pool anyway would kill the run the synchronous
         # path survives
-        from rnb_tpu.decode.native import DecodePool, NativeY4MDecoder
+        from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder,
+                                           PIX_RGB, PIX_YUV420)
         if isinstance(decoder, NativeY4MDecoder):
-            out = np.empty((n, self.consecutive_frames, FRAME_HW,
-                            FRAME_HW, 3), dtype=np.uint8)
+            out = np.empty(self._batch_shape(n), dtype=np.uint8)
+            pixfmt = (PIX_YUV420 if self.pixel_path == "yuv420"
+                      else PIX_RGB)
             pool = DecodePool.shared()
             tickets = []
             try:
@@ -303,7 +362,8 @@ class R2P1DLoader(StageModel):
                     hi = min(lo + self.POOL_CHUNK_CLIPS, n)
                     tickets.append(pool.submit_into(
                         video, starts[lo:hi], self.consecutive_frames,
-                        out[lo:hi]))
+                        out[lo:hi], pixfmt=pixfmt, width=FRAME_HW,
+                        height=FRAME_HW))
             except Exception:
                 # a partial submit must not leak the earlier tickets —
                 # un-waited tickets pin the batch buffer in the pool's
@@ -326,10 +386,7 @@ class R2P1DLoader(StageModel):
         def _work():
             # hand the decoded batch to the handle directly — no
             # staging copy into the preallocated buffer
-            handle.out = decoder.decode_clips(video, starts,
-                                              self.consecutive_frames,
-                                              width=FRAME_HW,
-                                              height=FRAME_HW)
+            handle.out = self._decode_sync(decoder, video, starts)
 
         handle.future = self._fallback_pool.submit(_work)
         return handle
@@ -337,11 +394,18 @@ class R2P1DLoader(StageModel):
     def _materialize(self, clips: np.ndarray, n: int, time_card):
         """Pad decoded clips to their row bucket, transfer, normalize."""
         import jax
-        padded = np.zeros(self._batch_shape(self._bucket_for(n)),
-                          dtype=np.uint8)
-        padded[:n] = clips
+        target = self._batch_shape(self._bucket_for(n))
+        if clips.shape == target:
+            # bucket == clip count (the dominant 1-clip case): the
+            # decode buffer already is the transfer buffer — no pad copy
+            padded = clips
+        else:
+            padded = np.zeros(target, dtype=np.uint8)
+            padded[:n] = clips
         device_u8 = jax.device_put(padded, self._jax_device)
-        if self.raw_output:
+        if self._preprocess is None:
+            # raw_output (mesh consumer) or yuv420 (network stage owns
+            # the fused ingest): u8 crosses the wire as-is
             return (PaddedBatch(device_u8, n),), None, time_card
         batch = self._preprocess(device_u8)
         return (PaddedBatch(batch, n),), None, time_card
@@ -368,9 +432,7 @@ class R2P1DLoader(StageModel):
         length = decoder.num_frames(video)
         starts = self.sampler.sample(length, video_id=video)
         starts = starts[: self.max_clips]
-        clips = decoder.decode_clips(video, starts,
-                                     self.consecutive_frames,
-                                     width=FRAME_HW, height=FRAME_HW)
+        clips = self._decode_sync(decoder, video, starts)
         n = clips.shape[0]
         time_card.num_clips = n
         return self._materialize(clips, n, time_card)
@@ -396,22 +458,32 @@ class R2P1DRunner(StageModel):
                  num_warmups: int = NUM_WARMUPS,
                  ckpt_path: Optional[str] = None,
                  row_buckets=None, factored_shortcut: bool = False,
-                 **kwargs):
+                 pixel_path: str = "rgb", **kwargs):
         super().__init__(device)
         import jax
         if not (1 <= start_index <= end_index <= NUM_LAYERS):
             raise ValueError("invalid layer range [%s..%s]"
                              % (start_index, end_index))
+        if pixel_path not in ("rgb", "yuv420"):
+            raise ValueError("pixel_path must be 'rgb' or 'yuv420', "
+                             "got %r" % (pixel_path,))
+        if pixel_path == "yuv420" and start_index != 1:
+            raise ValueError("pixel_path='yuv420' fuses the ingest in "
+                             "front of layer 1; a [%d..%d] stage "
+                             "receives activations, not frames"
+                             % (start_index, end_index))
         self.start_index = int(start_index)
         self.end_index = int(end_index)
         self.max_rows = int(max_rows)
+        self.pixel_path = pixel_path
         layer_sizes = tuple(layer_sizes)
         self._jax_device = _resolve(device)
         # factored_shortcut matches converted reference checkpoints
         # (models/r2p1d/convert.py); default is the plain projection
         self._apply = _shared_apply(self.start_index, self.end_index,
                                     num_classes, layer_sizes,
-                                    bool(factored_shortcut))
+                                    bool(factored_shortcut),
+                                    pixel_path=pixel_path)
         self._variables = _shared_params(self.start_index, self.end_index,
                                          num_classes, layer_sizes,
                                          ckpt_path, self._jax_device,
@@ -422,20 +494,29 @@ class R2P1DRunner(StageModel):
         # upstream range [1..start-1] downsampled those frames to (the
         # static LAYER_INPUT_SHAPES table only covers the default 8)
         from rnb_tpu.models.r2p1d.network import range_output_shape
-        if self.start_index == 1:
+        if self.pixel_path == "yuv420":
+            from rnb_tpu.ops.yuv import packed_frame_bytes
+            shape = (int(consecutive_frames),
+                     packed_frame_bytes(FRAME_HW, FRAME_HW))
+        elif self.start_index == 1:
             shape = (int(consecutive_frames),) + LAYER_INPUT_SHAPES[1][1:]
         else:
             shape = range_output_shape(1, self.start_index - 1,
                                        int(consecutive_frames))
         self._steady_shape = (self.max_rows,) + tuple(shape)
         # warm up with the dtype the pipeline actually flows: the
-        # loader's preprocess emits bfloat16 into layer 1, while an
-        # upstream network stage emits float32 activations
+        # loader's preprocess emits bfloat16 into layer 1 (packed uint8
+        # planes under pixel_path='yuv420'), while an upstream network
+        # stage emits float32 activations
         # (R2Plus1DClassifier casts its output) — a wrong-dtype dummy
         # would compile a signature the hot loop never uses and pay the
         # real compile on the first request instead
         import jax.numpy as jnp
-        warm_dtype = jnp.bfloat16 if self.start_index == 1 else jnp.float32
+        if self.pixel_path == "yuv420":
+            warm_dtype = jnp.uint8
+        else:
+            warm_dtype = (jnp.bfloat16 if self.start_index == 1
+                          else jnp.float32)
         # match the loader's row bucketing: compile one executable per
         # bucket row count so no compile lands in the measured window
         warm_rows = _normalize_row_buckets(row_buckets, self.max_rows,
@@ -509,7 +590,9 @@ class R2P1DSingleStep(StageModel):
                                ckpt_path=ckpt_path,
                                row_buckets=kwargs.get("row_buckets"),
                                factored_shortcut=kwargs.get(
-                                   "factored_shortcut", False))
+                                   "factored_shortcut", False),
+                               pixel_path=kwargs.get("pixel_path",
+                                                     "rgb"))
 
     def input_shape(self):
         return None

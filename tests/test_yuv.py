@@ -1,0 +1,218 @@
+"""The yuv420 pixel path: packed-plane decode backends + on-device
+colourspace conversion.
+
+Contract under test (rnb_tpu/ops/yuv.py docstring):
+  * numpy vs native packed-plane gathers are BIT-EXACT;
+  * the jnp converter matches the numpy oracle within 1 u8 LSB (XLA
+    may contract mul+add into FMA);
+  * luma is bit-exact with the RGB pixel path (same index map);
+  * the loader's yuv420 mode ships packed u8 and the network stage's
+    fused ingest produces the same predictions as the rgb path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from rnb_tpu.decode import (SyntheticDecoder, Y4MDecoder, get_decoder,
+                            write_y4m)
+from rnb_tpu.ops.yuv import (packed_frame_bytes, yuv420_to_rgb_numpy,
+                             yuv420_to_rgb_u8)
+
+
+def _make_y4m(tmp_path, name="vid.y4m", frames=24, h=96, w=128, seed=7):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (frames, h, w, 3), dtype=np.uint8)
+    path = os.path.join(str(tmp_path), name)
+    write_y4m(path, data)
+    return path
+
+
+def test_packed_frame_bytes():
+    assert packed_frame_bytes(112, 112) == 112 * 112 * 3 // 2
+    with pytest.raises(ValueError):
+        packed_frame_bytes(111, 112)
+
+
+def test_numpy_yuv_matches_rgb_exactly_when_chroma_constant(tmp_path):
+    """The two pixel paths differ ONLY in chroma index choice, so on a
+    video with exactly constant chroma planes (U=V=128 raw) re-deriving
+    RGB from the packed planes must be bit-exact with the direct RGB
+    decode. (write_y4m's RGB->YUV roundtrip would leave ±1 chroma
+    residue, so the 4:2:0 payload is written directly.)"""
+    rng = np.random.default_rng(3)
+    h, w, n = 96, 128, 20
+    path = os.path.join(str(tmp_path), "gray.y4m")
+    with open(path, "wb") as f:
+        f.write(b"YUV4MPEG2 W%d H%d F25:1 Ip A1:1 C420\n" % (w, h))
+        for _ in range(n):
+            f.write(b"FRAME\n")
+            f.write(rng.integers(0, 256, h * w, dtype=np.uint8)
+                    .tobytes())
+            f.write(np.full((h // 2) * (w // 2) * 2, 128,
+                            np.uint8).tobytes())
+    dec = Y4MDecoder()
+    packed = dec.decode_clips_yuv(path, [0, 5], consecutive_frames=4,
+                                  width=56, height=48)
+    assert packed.shape == (2, 4, packed_frame_bytes(48, 56))
+    assert packed.dtype == np.uint8
+    rgb = dec.decode_clips(path, [0, 5], consecutive_frames=4,
+                           width=56, height=48)
+    re_rgb = yuv420_to_rgb_numpy(packed, 48, 56)
+    np.testing.assert_array_equal(re_rgb, rgb)
+
+
+def _smooth_frames(n=12, h=96, w=128):
+    """Real-video-like moving gradients (noise frames would make the
+    rgb-vs-yuv420 chroma index difference look maximal; real chroma is
+    locally smooth)."""
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    t = np.arange(n, dtype=np.float32)[:, None, None]
+    frames = np.empty((n, h, w, 3), np.uint8)
+    for c in range(3):
+        frames[..., c] = (127.5 * (1 + np.sin(
+            2 * np.pi * (yy / h + xx / w) + 0.3 * c + 0.1 * t))
+        ).astype(np.uint8)
+    return frames
+
+
+def test_numpy_yuv_close_on_smooth_content(tmp_path):
+    """On smooth (real-video-like) content the half-res chroma map
+    stays within a few LSB of the rgb path everywhere."""
+    frames = _smooth_frames()
+    path = os.path.join(str(tmp_path), "smooth.y4m")
+    write_y4m(path, frames)
+    dec = Y4MDecoder()
+    packed = dec.decode_clips_yuv(path, [0], consecutive_frames=8,
+                                  width=56, height=48)
+    rgb = dec.decode_clips(path, [0], consecutive_frames=8,
+                           width=56, height=48)
+    re_rgb = yuv420_to_rgb_numpy(packed, 48, 56)
+    diff = np.abs(re_rgb.astype(int) - rgb.astype(int))
+    # the chroma sample position can shift by ~1 source pixel in each
+    # axis; on this gradient that is a handful of LSB
+    assert np.percentile(diff, 50) <= 2
+    assert np.percentile(diff, 99) <= 12
+    assert diff.max() <= 24
+
+
+def test_numpy_vs_native_yuv_bit_exact(tmp_path):
+    from rnb_tpu.decode.native import NativeY4MDecoder, native_available
+    if not native_available():
+        pytest.skip("native decoder not built")
+    path = _make_y4m(tmp_path, frames=30, h=120, w=160)
+    a = Y4MDecoder().decode_clips_yuv(path, [0, 3, 25],
+                                      consecutive_frames=8,
+                                      width=112, height=112)
+    b = NativeY4MDecoder(use_pool=False).decode_clips_yuv(
+        path, [0, 3, 25], consecutive_frames=8, width=112, height=112)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_native_pool_yuv_bit_exact(tmp_path):
+    from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder,
+                                       native_available)
+    from rnb_tpu.decode.native import PIX_YUV420
+    if not native_available():
+        pytest.skip("native decoder not built")
+    path = _make_y4m(tmp_path, frames=16, h=96, w=128)
+    want = Y4MDecoder().decode_clips_yuv(path, [0, 8],
+                                         consecutive_frames=8,
+                                         width=112, height=112)
+    pool = DecodePool(num_threads=2)
+    try:
+        out = np.empty_like(want)
+        t = pool.submit_into(path, [0, 8], 8, out, pixfmt=PIX_YUV420)
+        pool.wait(t, path)
+        np.testing.assert_array_equal(out, want)
+    finally:
+        pool.close()
+
+
+def test_synthetic_yuv_deterministic():
+    dec = SyntheticDecoder()
+    a = dec.decode_clips_yuv("synth://v1", [0, 10], 8, 112, 112)
+    b = dec.decode_clips_yuv("synth://v1", [0, 10], 8, 112, 112)
+    assert a.shape == (2, 8, packed_frame_bytes(112, 112))
+    np.testing.assert_array_equal(a, b)
+    c = dec.decode_clips_yuv("synth://v2", [0, 10], 8, 112, 112)
+    assert not np.array_equal(a, c)
+
+
+def test_device_converter_matches_numpy_oracle(tmp_path):
+    import jax
+    path = _make_y4m(tmp_path, frames=12, h=96, w=128)
+    packed = Y4MDecoder().decode_clips_yuv(path, [0], 8, 112, 112)
+    want = yuv420_to_rgb_numpy(packed, 112, 112)
+    got = np.asarray(jax.jit(
+        lambda x: yuv420_to_rgb_u8(x, 112, 112))(packed))
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+
+def test_normalize_yuv420_range():
+    import jax.numpy as jnp
+    from rnb_tpu.ops.yuv import normalize_yuv420
+    rng = np.random.default_rng(0)
+    packed = rng.integers(0, 256, (2, 4, packed_frame_bytes(112, 112)),
+                          dtype=np.uint8)
+    out = normalize_yuv420(packed, 112, 112)
+    assert out.shape == (2, 4, 112, 112, 3)
+    assert out.dtype == jnp.bfloat16
+    f = np.asarray(out, dtype=np.float32)
+    assert f.min() >= -1.0 and f.max() <= 1.0
+
+
+def test_loader_yuv_output_shape_and_pipeline_parity(tmp_path):
+    """yuv420 loader ships packed u8; a start_index=1 runner configured
+    for yuv420 accepts it and its logits track the rgb path's."""
+    import jax
+    from rnb_tpu.models.r2p1d.model import (R2P1DLoader, R2P1DRunner,
+                                            FRAME_HW)
+    shape = R2P1DLoader.output_shape_for(max_clips=15,
+                                         consecutive_frames=8,
+                                         pixel_path="yuv420")
+    assert shape == ((15, 8, packed_frame_bytes(FRAME_HW, FRAME_HW)),)
+
+    frames = _smooth_frames(n=40)
+    path = os.path.join(str(tmp_path), "vid.y4m")
+    write_y4m(path, frames)
+    dev = jax.devices()[0]
+    fixed = dict(num_clips_population=[2], weights=[1], max_clips=2,
+                 num_warmups=0)
+    loader = R2P1DLoader(dev, pixel_path="yuv420", **fixed)
+    (pb,), _, tc = loader(None, path, _card(path))
+    assert pb.data.shape == (2, 8, packed_frame_bytes(FRAME_HW,
+                                                      FRAME_HW))
+    assert str(pb.data.dtype) == "uint8"
+
+    net = dict(start_index=1, end_index=5, num_warmups=0,
+               layer_sizes=(1, 1, 1, 1), max_rows=2, num_classes=16)
+    runner = R2P1DRunner(dev, pixel_path="yuv420", **net)
+    (logits,), _, _ = runner((pb,), None, tc)
+    assert logits.data.shape == (2, 16)
+
+    # rgb reference prediction on the same video, same weights
+    loader_rgb = R2P1DLoader(dev, **fixed)
+    runner_rgb = R2P1DRunner(dev, **net)
+    (pb2,), _, tc2 = loader_rgb(None, path, _card(path))
+    (logits2,), _, _ = runner_rgb((pb2,), None, tc2)
+    a = np.asarray(logits.data, dtype=np.float32)
+    b = np.asarray(logits2.data, dtype=np.float32)
+    # the pixel paths differ by <=1 chroma source pixel on smooth
+    # content; logits must track closely (bf16 activations)
+    np.testing.assert_allclose(a, b, atol=0.05 * np.abs(b).max())
+
+
+def test_runner_yuv_requires_layer1():
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DRunner
+    with pytest.raises(ValueError):
+        R2P1DRunner(jax.devices()[0], start_index=2, end_index=5,
+                    num_warmups=0, layer_sizes=(1, 1, 1, 1),
+                    pixel_path="yuv420")
+
+
+def _card(video):
+    from rnb_tpu.telemetry import TimeCard
+    return TimeCard(0)
