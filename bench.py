@@ -4,12 +4,16 @@ Reproduces the reference's benchmark methodology (SURVEY.md §6) on this
 framework, driven in bulk (max-throughput) mode against the baseline
 from the reference's only published number (11.3 videos/s on one GPU
 over config/r2p1d-whole.json, reference README.md:176-178). The default
-topology here is ``configs/rnb-1chip.json`` — the reference's own
-flagship Replicate & Batch idea (content-routed lanes + dynamic
-batching, reference config/rnb.json) on a single chip; it outperforms
-the plain 2-stage ``r2p1d-whole`` topology, which remains measured
-side-by-side in scripts/bench_matrix.py for the like-for-like
-comparison.
+topology here is ``configs/r2p1d-whole-yuv.json`` — the reference's own
+headline topology (the 2-stage loader -> full-net pipeline of
+config/r2p1d-whole.json) over the yuv420 pixel path: the host gathers
+packed 4:2:0 planes, and chroma upsample + BT.601 + normalize fuse
+into the network stage's jit (rnb_tpu/ops/yuv.py). With the
+colourspace arithmetic off the host, the plain 2-stage pipeline
+outruns the batched Replicate & Batch topology (654 vs 481 videos/s in
+the round-4 matrix) — the batcher's host fuse hop no longer buys
+anything once dispatches stop being the bottleneck; both remain
+measured side-by-side in scripts/bench_matrix.py.
 
 **Real decode by default.** The reference's number includes real video
 decode through NVVL (reference models/r2p1d/model.py:140-151), so this
@@ -46,7 +50,8 @@ exit; an external SIGKILL on a TPU-attached process is what wedges the
 tunnel in the first place) — retrying with backoff within a time
 budget.
 
-Env knobs: RNB_BENCH_VIDEOS (default 4000: >10s measured window on
+Env knobs: RNB_BENCH_VIDEOS (default 8000: ~12s measured window at
+the round-4 654 videos/s on
 TPU), RNB_BENCH_CONFIG, RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk),
 RNB_BENCH_DATASET (y4m|synth, default y4m), RNB_TPU_DATA_ROOT (use an
 existing dataset instead of generating), RNB_BENCH_PLATFORM (e.g.
@@ -176,7 +181,11 @@ def _dataset_spec():
     return ("--labels", e("RNB_BENCH_DATASET_LABELS", "4"),
             "--videos-per-label", e("RNB_BENCH_DATASET_VPL", "11"),
             "--frames", e("RNB_BENCH_DATASET_FRAMES", "128"),
-            "--size", e("RNB_BENCH_DATASET_SIZE", "192x256"))
+            "--size", e("RNB_BENCH_DATASET_SIZE", "192x256"),
+            # 4:2:0 like real video — and decode is read-bandwidth
+            # bound once the colourspace math runs on device, so the
+            # stand-in for codec output should not double the bytes
+            "--colorspace", e("RNB_BENCH_DATASET_COLORSPACE", "420"))
 
 
 def _count_y4m(root: str) -> int:
@@ -358,10 +367,10 @@ def main() -> int:
         if err:
             return _emit_error(err)
 
-    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "4000"))
+    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "8000"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",
-        os.path.join(repo_dir, "configs", "rnb-1chip.json"))
+        os.path.join(repo_dir, "configs", "r2p1d-whole-yuv.json"))
     mean_interval = int(os.environ.get("RNB_BENCH_MEAN_INTERVAL_MS", "0"))
 
     # the probe leaves one gap: the tunnel can wedge *between* the

@@ -258,17 +258,34 @@ class Y4MDecoder(VideoDecoder):
         return out
 
 
-def write_y4m(path: str, frames: np.ndarray) -> None:
-    """Write (N, H, W, 3) uint8 RGB frames as a 4:4:4 y4m file (RGB
-    stored via inverse BT.601) — used by tests and data generators."""
+def write_y4m(path: str, frames: np.ndarray,
+              colorspace: str = "444") -> None:
+    """Write (N, H, W, 3) uint8 RGB frames as a y4m file (RGB stored
+    via inverse BT.601) — used by tests and data generators.
+
+    ``colorspace="420"`` downsamples chroma with a 2x2 box mean
+    (geometry must be even) — the colourspace virtually all real video
+    ships in, and half the bytes per frame of 4:4:4, which matters
+    because uncompressed-read bandwidth stands in for the codec here.
+    """
     n, h, w, _ = frames.shape
     rgb = frames.astype(np.float32)
     r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
     y = 0.299 * r + 0.587 * g + 0.114 * b
     u = (b - y) / 1.772 + 128.0
     v = (r - y) / 1.402 + 128.0
+    if colorspace == "420":
+        if h % 2 or w % 2:
+            raise ValueError("4:2:0 needs even geometry, got %dx%d"
+                             % (h, w))
+        u = u.reshape(n, h // 2, 2, w // 2, 2).mean(axis=(2, 4))
+        v = v.reshape(n, h // 2, 2, w // 2, 2).mean(axis=(2, 4))
+    elif colorspace != "444":
+        raise ValueError("colorspace must be '444' or '420', got %r"
+                         % (colorspace,))
     with open(path, "wb") as f:
-        f.write(b"YUV4MPEG2 W%d H%d F25:1 Ip A1:1 C444\n" % (w, h))
+        f.write(b"YUV4MPEG2 W%d H%d F25:1 Ip A1:1 C%s\n"
+                % (w, h, colorspace.encode()))
         for i in range(n):
             f.write(b"FRAME\n")
             for plane in (y[i], u[i], v[i]):

@@ -40,8 +40,11 @@ def _cells(poisson_mi: int):
     return [
         ("configs/r2p1d-whole.json", 0),
         ("configs/r2p1d-whole.json", poisson_mi),
+        ("configs/r2p1d-whole-yuv.json", 0),
         ("configs/rnb-1chip.json", 0),
         ("configs/rnb-1chip.json", poisson_mi),
+        ("configs/rnb-1chip-yuv.json", 0),
+        ("configs/rnb-1chip-yuv.json", poisson_mi),
         ("configs/r2p1d-nopipeline-1chip.json", 0),
         ("configs/r2p1d-split-1chip.json", 0),
     ]
@@ -92,7 +95,10 @@ def main() -> int:
         # Poisson cells run fewer videos: the arrival process adds idle
         # gaps, and the cell's job is the latency distribution, not a
         # long throughput window
-        n = videos if mi == 0 else max(200, videos // 4)
+        # Poisson cells: enough arrivals that the measured window still
+        # exceeds ~10 s at mi=6 ms (the cell's job is the latency
+        # distribution under load, but a too-short window is noise)
+        n = videos if mi == 0 else max(200, videos // 2)
         n = min(n, SLOW_CONFIGS.get(config, n))
         if backend_down:
             # don't burn a full probe budget per remaining cell once

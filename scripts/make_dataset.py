@@ -54,6 +54,10 @@ def main(argv=None) -> int:
     parser.add_argument("--frames", type=int, default=96)
     parser.add_argument("--size", default="240x320",
                         help="HxW of the source frames")
+    parser.add_argument("--colorspace", default="444",
+                        choices=("444", "420"),
+                        help="y4m chroma format; 420 halves the bytes "
+                             "per frame and matches real video")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -67,7 +71,7 @@ def main(argv=None) -> int:
             # sequence seed: collision-free for any label/video counts
             frames = synth_frames(args.frames, height, width,
                                   seed=[args.seed, li, vi])
-            write_y4m(path, frames)
+            write_y4m(path, frames, colorspace=args.colorspace)
             count += 1
     print("wrote %d videos under %s" % (count, args.root))
     return 0

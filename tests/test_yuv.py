@@ -130,6 +130,44 @@ def test_native_pool_yuv_bit_exact(tmp_path):
         pool.close()
 
 
+def test_write_y4m_420_roundtrip(tmp_path):
+    """4:2:0 dataset files decode through both pixel paths, and the
+    numpy/native backends stay bit-exact on them."""
+    frames = _smooth_frames(n=10, h=64, w=96)
+    path = os.path.join(str(tmp_path), "v420.y4m")
+    write_y4m(path, frames, colorspace="420")
+    dec = Y4MDecoder()
+    assert dec.num_frames(path) == 10
+    assert dec._parse_header(path)["subsample"] == 2
+    rgb = dec.decode_clips(path, [0], 4, width=48, height=32)
+    assert rgb.shape == (1, 4, 32, 48, 3)
+    # the numpy yuv gather of the production (4:2:0) format must hold
+    # regardless of whether the native library is built
+    a = dec.decode_clips_yuv(path, [0, 3], 4, width=48, height=32)
+    assert a.shape == (2, 4, packed_frame_bytes(32, 48))
+    re_rgb = yuv420_to_rgb_numpy(a, 32, 48)
+    got = dec.decode_clips(path, [0, 3], 4, width=48, height=32)
+    assert np.abs(re_rgb.astype(int) - got.astype(int)).max() <= 24
+    from rnb_tpu.decode.native import NativeY4MDecoder, native_available
+    if native_available():
+        b = NativeY4MDecoder(use_pool=False).decode_clips_yuv(
+            path, [0, 3], 4, width=48, height=32)
+        np.testing.assert_array_equal(a, b)
+        c = NativeY4MDecoder(use_pool=False).decode_clips(
+            path, [0, 3], 4, width=48, height=32)
+        d = dec.decode_clips(path, [0, 3], 4, width=48, height=32)
+        np.testing.assert_array_equal(c, d)
+
+
+def test_write_y4m_rejects_bad_colorspace(tmp_path):
+    with pytest.raises(ValueError):
+        write_y4m(os.path.join(str(tmp_path), "x.y4m"),
+                  np.zeros((1, 4, 4, 3), np.uint8), colorspace="422")
+    with pytest.raises(ValueError):
+        write_y4m(os.path.join(str(tmp_path), "x.y4m"),
+                  np.zeros((1, 5, 4, 3), np.uint8), colorspace="420")
+
+
 def test_synthetic_yuv_deterministic():
     dec = SyntheticDecoder()
     a = dec.decode_clips_yuv("synth://v1", [0, 10], 8, 112, 112)
