@@ -4,16 +4,16 @@ Reproduces the reference's benchmark methodology (SURVEY.md §6) on this
 framework, driven in bulk (max-throughput) mode against the baseline
 from the reference's only published number (11.3 videos/s on one GPU
 over config/r2p1d-whole.json, reference README.md:176-178). The default
-topology here is ``configs/r2p1d-whole-yuv.json`` — the reference's own
-headline topology (the 2-stage loader -> full-net pipeline of
-config/r2p1d-whole.json) over the yuv420 pixel path: the host gathers
-packed 4:2:0 planes, and chroma upsample + BT.601 + normalize fuse
-into the network stage's jit (rnb_tpu/ops/yuv.py). With the
-colourspace arithmetic off the host, the plain 2-stage pipeline
-outruns the batched Replicate & Batch topology (654 vs 481 videos/s in
-the round-4 matrix) — the batcher's host fuse hop no longer buys
-anything once dispatches stop being the bottleneck; both remain
-measured side-by-side in scripts/bench_matrix.py.
+topology here is ``configs/rnb-fused-yuv.json`` — the reference's
+Replicate & Batch idea collapsed into the loader: R2P1DFusingLoader
+submits every request to the decode pool on receipt, harvests
+completed decodes and ships one fused device batch straight to the
+network stage, whose jit opens with the yuv420 ingest (packed 4:2:0
+planes -> chroma upsample -> BT.601 -> normalize, rnb_tpu/ops/yuv.py).
+Batching without the extra host stage that made the standalone Batcher
+topology host-bound (rnb-1chip measured 481 vs 874-909 fused in round
+4); the 2-stage ``r2p1d-whole-yuv`` and the reference-shaped
+``rnb-1chip`` remain measured side-by-side in scripts/bench_matrix.py.
 
 **Real decode by default.** The reference's number includes real video
 decode through NVVL (reference models/r2p1d/model.py:140-151), so this
@@ -370,7 +370,7 @@ def main() -> int:
     num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "8000"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",
-        os.path.join(repo_dir, "configs", "r2p1d-whole-yuv.json"))
+        os.path.join(repo_dir, "configs", "rnb-fused-yuv.json"))
     mean_interval = int(os.environ.get("RNB_BENCH_MEAN_INTERVAL_MS", "0"))
 
     # the probe leaves one gap: the tunnel can wedge *between* the

@@ -391,6 +391,13 @@ struct Pool {
     return rc;
   }
 
+  // Non-blocking: has this ticket finished? Does NOT retire it — the
+  // result code stays queued for a later Wait().
+  bool Peek(long long ticket) {
+    std::lock_guard<std::mutex> lk(mu);
+    return done.count(ticket) > 0;
+  }
+
   ~Pool() {
     {
       std::lock_guard<std::mutex> lk(mu);
@@ -476,6 +483,12 @@ long long rnb_pool_submit_fmt(void* pool, const char* path,
 int rnb_pool_wait(void* pool, long long ticket) {
   if (!pool || ticket <= 0) return kErrArg;
   return static_cast<Pool*>(pool)->Wait(ticket);
+}
+
+// 1 = done (result still pending retrieval via wait), 0 = in flight.
+int rnb_pool_peek(void* pool, long long ticket) {
+  if (!pool || ticket <= 0) return kErrArg;
+  return static_cast<Pool*>(pool)->Peek(ticket) ? 1 : 0;
 }
 
 }  // extern "C"

@@ -65,7 +65,8 @@ def load_native():
         for sym in ("rnb_y4m_probe", "rnb_y4m_decode_clips",
                     "rnb_y4m_decode_clips_fmt", "rnb_pool_create",
                     "rnb_pool_destroy", "rnb_pool_submit",
-                    "rnb_pool_submit_fmt", "rnb_pool_wait"):
+                    "rnb_pool_submit_fmt", "rnb_pool_wait",
+                    "rnb_pool_peek"):
             if not hasattr(lib, sym):
                 return None
         lib.rnb_y4m_probe.restype = ctypes.c_int
@@ -88,6 +89,8 @@ def load_native():
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
         lib.rnb_pool_wait.restype = ctypes.c_int
         lib.rnb_pool_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.rnb_pool_peek.restype = ctypes.c_int
+        lib.rnb_pool_peek.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
         lib.rnb_y4m_decode_clips_fmt.restype = ctypes.c_int
         lib.rnb_y4m_decode_clips_fmt.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
@@ -198,6 +201,15 @@ class DecodePool:
         with self._pending_lock:
             self._pending[ticket] = (out, starts)
         return ticket
+
+    def peek(self, ticket: int) -> bool:
+        """Non-blocking: True when the ticket's decode has finished.
+        Does not retire the ticket — pair with :meth:`wait`."""
+        with self._pending_lock:
+            if ticket not in self._pending:
+                raise ValueError("unknown or already-waited ticket %r"
+                                 % (ticket,))
+        return bool(self._lib.rnb_pool_peek(self._pool, ticket))
 
     def wait(self, ticket: int, path: str = "<submitted>") -> None:
         # claim the ticket atomically before touching the native side:

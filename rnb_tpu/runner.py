@@ -46,6 +46,8 @@ from rnb_tpu.utils.class_utils import load_class
 
 NUM_SUMMARY_SKIPS = 10  # steady-state summaries skip warm records
 QUEUE_POLL_S = 0.05
+#: sentinel for "an idle poll produced an emission" in the hot loop
+_IDLE_EMIT = object()
 
 
 @dataclass
@@ -212,8 +214,18 @@ def runner(ctx: RunnerContext) -> None:
                 # that batch as one last item before draining, so the
                 # final ``num_videos mod batch`` requests complete
                 # instead of stranding the run
-                flushed, eos = None, False
-                if prefetch_depth > 0:
+                flushed = None
+                if saw_marker and prefetch_depth == 0:
+                    # draining: the stage may hold MORE than one pending
+                    # batch (e.g. a fusing loader's accumulator), so
+                    # keep calling flush() until it runs dry instead of
+                    # consuming one exit marker per flushed batch —
+                    # markers are finite (NUM_EXIT_MARKERS) and running
+                    # out would silently strand the tail requests
+                    flushed = _eos_flush(model)
+                    if flushed is None:
+                        break
+                elif prefetch_depth > 0:
                     while (not saw_marker
                            and len(pending) < prefetch_depth + 1):
                         try:
@@ -235,19 +247,32 @@ def runner(ctx: RunnerContext) -> None:
                         flushed = _eos_flush(model)
                         if flushed is None:
                             break  # end-of-stream, all work drained
-                        eos = True
                     else:
                         continue
                 else:
                     try:
                         item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
                     except queue.Empty:
-                        continue
-                    if item is None:
+                        # idle tick: give accumulator stages (fusing
+                        # loader) a chance to emit on hold-timeout —
+                        # without this, a decoded request would wait
+                        # for the NEXT arrival, paying a full
+                        # inter-arrival gap instead of max_hold_ms
+                        # (+<= QUEUE_POLL_S of poll granularity)
+                        idle_poll = getattr(model, "poll", None)
+                        if idle_poll is None:
+                            continue
+                        flushed = idle_poll()
+                        if flushed is None or flushed[2] is None:
+                            continue
+                        item = _IDLE_EMIT
+                    if item is _IDLE_EMIT:
+                        pass  # flushed already holds the emission
+                    elif item is None:
+                        saw_marker = True
                         flushed = _eos_flush(model)
                         if flushed is None:
                             break  # end-of-stream marker
-                        eos = True
                     else:
                         signal, non_tensors, time_card = item
                         time_card.add_device(ctx.device.label)
@@ -350,8 +375,10 @@ def runner(ctx: RunnerContext) -> None:
                         ctx.termination.raise_flag(
                             TerminationFlag.FRAME_QUEUE_FULL)
                         break
-                if eos:
-                    break  # the flushed item was the stream's last
+                # a flushed item does NOT end the loop: the stage may
+                # hold more (fusing loaders flush one batch per call);
+                # the loop re-enters the drain branch until flush()
+                # returns None
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
@@ -362,6 +389,14 @@ def runner(ctx: RunnerContext) -> None:
             for handle, nt, _tc in pending:
                 model.discard(handle, nt)
         pending.clear()
+        # same for a stage-internal accumulator (fusing loader): its
+        # submitted decodes must be retired or the shared pool pins
+        # their buffers for the process's life
+        if model is not None and hasattr(model, "discard_pending"):
+            try:
+                model.discard_pending()
+            except Exception:
+                traceback.print_exc()
         # drain: the LAST producer on each edge marks end-of-stream, so
         # markers can never overtake a slower sibling replica's real
         # items (improves on reference runner.py:238-245 which let any

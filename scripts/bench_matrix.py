@@ -44,7 +44,8 @@ def _cells(poisson_mi: int):
         ("configs/rnb-1chip.json", 0),
         ("configs/rnb-1chip.json", poisson_mi),
         ("configs/rnb-1chip-yuv.json", 0),
-        ("configs/rnb-1chip-yuv.json", poisson_mi),
+        ("configs/rnb-fused-yuv.json", 0),
+        ("configs/rnb-fused-yuv.json", poisson_mi),
         ("configs/r2p1d-nopipeline-1chip.json", 0),
         ("configs/r2p1d-split-1chip.json", 0),
     ]

@@ -1,0 +1,216 @@
+"""R2P1DFusingLoader: loader-side dynamic batching.
+
+Contract: every request is submitted to the decode pool on receipt;
+completed decodes are harvested FIFO and emitted as one fused padded
+batch with a TimeCardList; partial batches emit when nothing is in
+flight, on hold-timeout, or at end-of-stream (flush). Backpressure
+blocks on the oldest decode once `depth` requests are pending.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from rnb_tpu.decode import write_y4m
+from rnb_tpu.telemetry import TimeCard, TimeCardList
+
+
+def _dataset(tmp_path, n=12, frames=40, h=64, w=96):
+    rng = np.random.default_rng(5)
+    paths = []
+    for i in range(n):
+        p = os.path.join(str(tmp_path), "v%02d.y4m" % i)
+        write_y4m(p, rng.integers(0, 256, (frames, h, w, 3),
+                                  dtype=np.uint8))
+        paths.append(p)
+    return paths
+
+
+def _loader(fuse=3, **kw):
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader
+    kw.setdefault("num_clips_population", [1])
+    kw.setdefault("weights", [1])
+    kw.setdefault("num_warmups", 0)
+    return R2P1DFusingLoader(jax.devices()[0], fuse=fuse, **kw)
+
+
+def test_fuses_to_target(tmp_path):
+    paths = _dataset(tmp_path)
+    loader = _loader(fuse=3, max_hold_ms=10000.0, depth=50)
+    emitted = []
+    for i, p in enumerate(paths[:9]):
+        out = loader(None, p, TimeCard(i))
+        if out[2] is not None:
+            emitted.append(out)
+    # 9 requests x 1 clip, fuse=3 -> 3 fused batches once decodes land
+    # (timing-dependent: the early calls may swallow while decodes run,
+    # so drain the rest through flush and count totals)
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        emitted.append(out)
+    total = sum(len(tc) for _, _, tc in emitted)
+    assert total == 9
+    for (pb,), _, cards in emitted:
+        assert isinstance(cards, TimeCardList)
+        assert pb.valid == len(cards)  # 1 clip per request here
+        assert pb.data.shape[0] in (3, 6, 15)  # row buckets or max
+
+
+def test_emit_partial_when_idle(tmp_path):
+    paths = _dataset(tmp_path, n=2)
+    loader = _loader(fuse=5, max_hold_ms=10000.0)
+    out1 = loader(None, paths[0], TimeCard(0))
+    # either swallowed (decode still running) or emitted alone (decode
+    # caught up and nothing else is in flight) — never an error
+    if out1[2] is None:
+        import time
+        deadline = time.time() + 10
+        while loader._inflight and time.time() < deadline:
+            time.sleep(0.01)
+            loader._harvest()
+        out2 = loader(None, paths[1], TimeCard(1))
+        got = [o for o in (out1, out2) if o[2] is not None]
+        assert got, "decode caught up but nothing emitted"
+    else:
+        assert len(out1[2]) == 1
+
+
+def test_flush_drains_everything(tmp_path):
+    paths = _dataset(tmp_path, n=7)
+    loader = _loader(fuse=100, max_hold_ms=1e9, depth=100)
+    seen = 0
+    for i, p in enumerate(paths):
+        out = loader(None, p, TimeCard(i))
+        if out[2] is not None:
+            # "nothing in flight" emissions are legal mid-stream when
+            # decode outruns arrivals — count them too
+            seen += len(out[2])
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        seen += len(out[2])
+    assert seen == 7
+    assert loader.flush() is None
+
+
+def test_backpressure_blocks_and_emits(tmp_path):
+    paths = _dataset(tmp_path, n=6)
+    loader = _loader(fuse=100, max_hold_ms=1e9, depth=2)
+    emitted = []
+    for i, p in enumerate(paths):
+        out = loader(None, p, TimeCard(i))
+        if out[2] is not None:
+            emitted.append(out)
+    # depth=2: by request 3 the loader must start retiring decodes
+    assert emitted, "backpressure never forced an emission"
+    total = sum(len(tc) for _, _, tc in emitted)
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        total += len(out[2])
+    assert total == 6
+
+
+def test_idle_poll_emits_on_hold_timeout(tmp_path):
+    """The executor's idle tick must release a held batch once
+    max_hold_ms expires — without waiting for the next arrival."""
+    import time
+    paths = _dataset(tmp_path, n=3)
+    loader = _loader(fuse=100, max_hold_ms=30.0, depth=100)
+    got = 0
+    for i, p in enumerate(paths[:2]):
+        out = loader(None, p, TimeCard(i))
+        if out[2] is not None:
+            got += len(out[2])
+    # no further arrivals: only the executor's idle poll can release
+    # what is still held — it must fire within ~max_hold_ms
+    deadline = time.time() + 10
+    while got < 2 and time.time() < deadline:
+        time.sleep(0.01)
+        out = loader.poll()
+        if out is not None and out[2] is not None:
+            got += len(out[2])
+    assert got == 2
+    assert loader.flush() is None
+
+
+def test_discard_pending_retires_all_tickets(tmp_path):
+    """Abort path: every submitted decode (in flight AND harvested but
+    unemitted) must be retired so the shared pool pins no buffers."""
+    from rnb_tpu.decode.native import DecodePool, native_available
+    if not native_available():
+        pytest.skip("native decoder not built")
+    paths = _dataset(tmp_path, n=4)
+    loader = _loader(fuse=100, max_hold_ms=1e9, depth=100)
+    for i, p in enumerate(paths):
+        out = loader(None, p, TimeCard(i))
+        assert out[2] is None or len(out[2])  # swallow or emit
+    loader._harvest()  # some land in _ready with live tickets
+    loader.discard_pending()
+    assert not loader._inflight and not loader._ready
+    assert not DecodePool.shared()._pending
+
+
+def test_rejects_prefetch_kwarg():
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader
+    with pytest.raises(ValueError):
+        R2P1DFusingLoader(jax.devices()[0], prefetch=4, num_warmups=0)
+
+
+def test_fused_pipeline_end_to_end(tmp_path):
+    """Client -> FusingLoader -> net through the real runtime."""
+    import json
+
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.control import TerminationFlag
+    from rnb_tpu.models.r2p1d import checkpoint as ckpt
+
+    root = os.path.join(str(tmp_path), "data")
+    os.makedirs(os.path.join(root, "label0"))
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        write_y4m(os.path.join(root, "label0", "v%d.y4m" % i),
+                  rng.integers(0, 256, (30, 64, 64, 3), dtype=np.uint8))
+    os.environ["RNB_TPU_DATA_ROOT"] = root
+    try:
+        ckpt_path = os.path.join(str(tmp_path), "tiny.msgpack")
+        ckpt.save_checkpoint(ckpt_path, ckpt.init_variables(
+            seed=1, num_classes=8, layer_sizes=(1, 1, 1, 1)))
+        cfg = {
+            "video_path_iterator":
+                "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+            "pipeline": [
+                {"model":
+                    "rnb_tpu.models.r2p1d.model.R2P1DFusingLoader",
+                 "queue_groups": [{"devices": [0], "out_queues": [0]}],
+                 "num_shared_tensors": 10,
+                 "fuse": 2, "max_clips": 4,
+                 "num_clips_population": [2], "weights": [1],
+                 "consecutive_frames": 2, "num_warmups": 0,
+                 "pixel_path": "yuv420"},
+                {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+                 "queue_groups": [{"devices": [0], "in_queue": 0}],
+                 "start_index": 1, "end_index": 5, "num_classes": 8,
+                 "layer_sizes": [1, 1, 1, 1], "max_rows": 4,
+                 "consecutive_frames": 2, "num_warmups": 0,
+                 "ckpt_path": ckpt_path, "pixel_path": "yuv420"},
+            ],
+        }
+        cfg_path = os.path.join(str(tmp_path), "fused.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        res = run_benchmark(cfg_path, mean_interval_ms=0, num_videos=9,
+                            log_base=os.path.join(str(tmp_path), "logs"),
+                            print_progress=False)
+        assert res.termination_flag == \
+            TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+        assert res.num_videos == 9
+    finally:
+        os.environ.pop("RNB_TPU_DATA_ROOT", None)
