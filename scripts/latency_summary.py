@@ -59,8 +59,12 @@ def main(argv=None) -> int:
     print(grouped.to_string(index=False,
                             float_format=lambda v: "%.3f" % v))
     print()
+    import pandas as pd
     for _, row in grouped.iterrows():
-        total = sum(row[c] for c in component_cols)
+        # jobs are grouped over the UNION of every job's schema: a
+        # 2-stage job has no runner2 columns, which must read as
+        # "absent", not poison the total with NaN
+        total = sum(row[c] for c in component_cols if pd.notna(row[c]))
         print("%s: total %.3f ms end-to-end mean latency" % (row["job_id"],
                                                              total))
 
@@ -73,7 +77,10 @@ def main(argv=None) -> int:
         bottoms = [0.0] * len(grouped)
         xs = range(len(grouped))
         for col in component_cols:
-            vals = grouped[col].tolist()
+            # same union-of-schemas padding as the text path: a column
+            # absent from a job's schema contributes 0, not NaN (which
+            # would erase all later segments of that bar)
+            vals = grouped[col].fillna(0.0).tolist()
             ax.bar(xs, vals, bottom=bottoms, label=col)
             bottoms = [b + v for b, v in zip(bottoms, vals)]
         ax.set_xticks(list(xs))

@@ -98,6 +98,44 @@ def test_latency_summary_cli(tmp_path, capsys):
     assert "job-a" in captured.out
 
 
+def test_latency_summary_mixed_schemas(tmp_path, capsys):
+    """Jobs with different pipeline depths (different timing columns)
+    must each report a finite total — the union-of-schemas NaN padding
+    for columns a job lacks must not poison its sum."""
+    _make_job(str(tmp_path), "job-2stage", num_requests=4, mi=0)
+    # a deeper job with an extra stage's columns
+    keys = ["enqueue_filename", "runner0_start", "inference0_start",
+            "inference0_finish", "runner1_start", "inference1_start",
+            "inference1_finish", "runner2_start", "inference2_start",
+            "inference2_finish"]
+    summary = TimeCardSummary()
+    for req in range(3):
+        tc = TimeCard(req)
+        for k_idx, key in enumerate(keys):
+            tc.timings[key] = 2000.0 + req * 10.0 + k_idx * 0.5
+        tc.add_device("tpu0")
+        tc.add_device("tpu1")
+        tc.add_device("tpu2")
+        summary.register(tc)
+    path = logname("job-3stage", "tpu2", 0, 0, base=str(tmp_path))
+    with open(path, "w") as f:
+        summary.save_full_report(f)
+    with open(os.path.join(str(tmp_path), "job-3stage",
+                           "log-meta.txt"), "w") as f:
+        f.write("Args: Namespace(mean_interval_ms=0, batch_size=1, "
+                "videos=3, queue_size=500, "
+                "config_file_path='configs/rnb.json')\n")
+        f.write("2000.0 2050.0\nTermination flag: 0\n")
+
+    import latency_summary
+    rc = latency_summary.main(["--log-base", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for line in out.splitlines():
+        if "end-to-end mean latency" in line:
+            assert "nan" not in line.lower(), line
+
+
 def test_latency_summary_cli_empty(tmp_path):
     import latency_summary
     assert latency_summary.main(["--log-base", str(tmp_path)]) == 1
