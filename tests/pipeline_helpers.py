@@ -93,6 +93,31 @@ class TinySlowSink(StageModel):
         return None, non_tensors, time_card
 
 
+class HoardingSink(StageModel):
+    """Final stage that swallows EVERY item and releases them only at
+    end-of-stream, one per flush() call — a deterministic stand-in for
+    accumulator stages holding many pending batches at drain time."""
+
+    def __init__(self, device, **kwargs):
+        super().__init__(device)
+        self._held = []
+
+    @staticmethod
+    def output_shape():
+        return None
+
+    def __call__(self, tensors, non_tensors, time_card):
+        time_card.num_clips = 1  # completions show in clips_completed
+        self._held.append((non_tensors, time_card))
+        return None, None, None
+
+    def flush(self):
+        if not self._held:
+            return None
+        non_tensors, time_card = self._held.pop(0)
+        return None, non_tensors, time_card
+
+
 class CountingPathIterator(VideoPathIterator):
     """Yields synthetic request ids forever: video-0, video-1, ..."""
 

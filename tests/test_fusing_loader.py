@@ -169,6 +169,40 @@ def test_rejects_prefetch_kwarg():
         R2P1DFusingLoader(jax.devices()[0], prefetch=4, num_warmups=0)
 
 
+def test_drain_survives_more_batches_than_exit_markers(tmp_path):
+    """EOS drain regression: a stage holding MORE pending batches than
+    NUM_EXIT_MARKERS must still complete every request. The old drain
+    consumed one exit marker per flush() emission and broke the hot
+    loop after the first, stranding the tail (UNSET termination).
+    Driven with a deterministic hoarding stage that swallows every item
+    and releases exactly one per flush() call."""
+    import json
+
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.control import NUM_EXIT_MARKERS, TerminationFlag
+
+    n = NUM_EXIT_MARKERS + 5  # strictly more flushes than markers
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.HoardingSink",
+             "queue_groups": [{"devices": [-1]}]},
+        ],
+    }
+    cfg_path = os.path.join(str(tmp_path), "drain.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    res = run_benchmark(cfg_path, mean_interval_ms=0, num_videos=n,
+                        log_base=os.path.join(str(tmp_path), "logs"),
+                        print_progress=False)
+    assert res.termination_flag == \
+        TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    # completion-derived evidence (BenchmarkResult.num_videos merely
+    # echoes the request): every held card was registered at drain
+    assert res.clips_completed == n
+
+
 def test_fused_pipeline_end_to_end(tmp_path):
     """Client -> FusingLoader -> net through the real runtime."""
     import json
