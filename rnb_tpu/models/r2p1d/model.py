@@ -209,10 +209,9 @@ class R2P1DLoader(StageModel):
         if pixel_path not in ("rgb", "yuv420"):
             raise ValueError("pixel_path must be 'rgb' or 'yuv420', "
                              "got %r" % (pixel_path,))
-        if pixel_path == "yuv420" and raw_output:
-            raise ValueError("raw_output consumers (mesh stages) "
-                             "normalize rgb frames; combine with "
-                             "pixel_path='yuv420' is not supported")
+        # raw_output + yuv420 composes: the loader ships packed planes
+        # and the mesh consumer's sharded program runs the fused yuv
+        # ingest (configure the SAME pixel_path on both stages)
         self.pixel_path = pixel_path
         sampler_kwargs = {}
         if num_clips_population is not None:
@@ -816,7 +815,8 @@ class R2P1DMeshRunner(StageModel):
                  num_warmups: int = NUM_WARMUPS,
                  ckpt_path: Optional[str] = None,
                  max_inflight: int = 4, sync_preds: bool = True,
-                 factored_shortcut: bool = False, **kwargs):
+                 factored_shortcut: bool = False,
+                 pixel_path: str = "rgb", **kwargs):
         super().__init__(device)
         from collections import deque
 
@@ -842,7 +842,9 @@ class R2P1DMeshRunner(StageModel):
             mesh, max_clips=self.max_clips,
             consecutive_frames=self.consecutive_frames,
             num_classes=num_classes, layer_sizes=tuple(layer_sizes),
-            ckpt_path=ckpt_path, factored_shortcut=factored_shortcut)
+            ckpt_path=ckpt_path, factored_shortcut=factored_shortcut,
+            pixel_path=pixel_path)
+        self.pixel_path = pixel_path
         self._acc = []            # (PaddedBatch, TimeCard) awaiting dp fill
         self._inflight = deque()  # unretired device prediction arrays
         dummy = np.zeros(self._si.batch_shape(self.dp), np.uint8)
@@ -851,8 +853,9 @@ class R2P1DMeshRunner(StageModel):
             jax.block_until_ready(self._si.run(vids, mask))
 
     def input_shape(self):
-        return ((self.max_clips, self.consecutive_frames, FRAME_HW,
-                 FRAME_HW, 3),)
+        # one source of truth for the per-video shape in either pixel
+        # path: the sharded step's own batch geometry
+        return (self._si.batch_shape(1)[1:],)
 
     @staticmethod
     def output_shape():
@@ -887,7 +890,19 @@ class R2P1DMeshRunner(StageModel):
         return None, preds, out_card
 
     def __call__(self, tensors, non_tensors, time_card):
-        self._acc.append((tensors[0], time_card))
+        pb = tensors[0]
+        want = self.input_shape()[0]
+        if tuple(pb.data.shape) != tuple(want):
+            # fail fast with the likely cause: the loader and this
+            # stage must agree on pixel_path (a mismatch would
+            # otherwise surface as a cryptic shape error deep inside
+            # shard_map tracing)
+            raise ValueError(
+                "mesh stage received batch shape %r but expects %r — "
+                "do the loader's and this stage's pixel_path settings "
+                "agree? (this stage: %r)"
+                % (tuple(pb.data.shape), tuple(want), self.pixel_path))
+        self._acc.append((pb, time_card))
         if len(self._acc) < self.dp:
             return None, None, None  # swallow until the dp axis fills
         pbs, cards = zip(*self._acc)

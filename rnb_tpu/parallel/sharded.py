@@ -63,7 +63,8 @@ class ShardedInference:
                  ckpt_path: Optional[str] = None,
                  dp_axis: str = "dp", sp_axis: str = "sp",
                  variables: Optional[Any] = None,
-                 factored_shortcut: bool = False):
+                 factored_shortcut: bool = False,
+                 pixel_path: str = "rgb"):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -71,6 +72,9 @@ class ShardedInference:
         if dp_axis not in mesh.axis_names or sp_axis not in mesh.axis_names:
             raise ValueError("mesh %r lacks axis %r/%r"
                              % (mesh.axis_names, dp_axis, sp_axis))
+        if pixel_path not in ("rgb", "yuv420"):
+            raise ValueError("pixel_path must be 'rgb' or 'yuv420', "
+                             "got %r" % (pixel_path,))
         self.mesh = mesh
         self.max_clips = int(max_clips)
         self.consecutive_frames = int(consecutive_frames)
@@ -78,6 +82,7 @@ class ShardedInference:
         self.num_classes = int(num_classes)
         self.dp_axis = dp_axis
         self.sp_axis = sp_axis
+        self.pixel_path = pixel_path
         dtype = dtype or jnp.bfloat16
         layer_sizes = tuple(layer_sizes)
 
@@ -117,11 +122,21 @@ class ShardedInference:
         except ImportError:  # older jax
             from jax.experimental.shard_map import shard_map
 
+        hw = self.frame_hw
+
         def step(variables, vids, mask):
-            # local shapes: vids (v, c, F, H, W, 3), mask (v, c)
+            # local shapes: vids (v, c, F, H, W, 3) for rgb or
+            # (v, c, F, packed) for yuv420; mask (v, c)
             v, c = vids.shape[0], vids.shape[1]
-            x = normalize_u8(vids.reshape((v * c,) + vids.shape[2:]),
-                             dtype)
+            flat = vids.reshape((v * c,) + vids.shape[2:])
+            if pixel_path == "yuv420":
+                # the same fused on-device ingest the single-chip
+                # network stage runs (rnb_tpu/ops/yuv.py), here inside
+                # the sharded program so it shards with the clip axis
+                from rnb_tpu.ops.yuv import normalize_yuv420
+                x = normalize_yuv420(flat, hw, hw, dtype)
+            else:
+                x = normalize_u8(flat, dtype)
             logits = model.apply(variables, x, train=False)
             logits = logits.reshape(v, c, self.num_classes)
             per_video = (logits * mask[..., None]).sum(axis=1)
@@ -135,13 +150,19 @@ class ShardedInference:
             self._run = jax.jit(sharded)
         else:
             def padded(variables, vids, mask):
+                # rank differs per pixel path — pad only the clip axis
                 vids = jnp.pad(
-                    vids, ((0, 0), (0, clip_pad)) + ((0, 0),) * 4)
+                    vids, ((0, 0), (0, clip_pad))
+                    + ((0, 0),) * (vids.ndim - 2))
                 mask = jnp.pad(mask, ((0, 0), (0, clip_pad)))
                 return sharded(variables, vids, mask)
             self._run = jax.jit(padded)
 
     def batch_shape(self, num_videos: int) -> Tuple[int, ...]:
+        if self.pixel_path == "yuv420":
+            from rnb_tpu.ops.yuv import packed_frame_bytes
+            return (num_videos, self.max_clips, self.consecutive_frames,
+                    packed_frame_bytes(self.frame_hw, self.frame_hw))
         return (num_videos, self.max_clips, self.consecutive_frames,
                 self.frame_hw, self.frame_hw, 3)
 

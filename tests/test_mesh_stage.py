@@ -19,7 +19,7 @@ TINY = dict(max_clips=2, consecutive_frames=2, num_classes=8,
             layer_sizes=[1, 1, 1, 1], num_warmups=1)
 
 
-def _mesh_config(tmp_path, mesh_devices):
+def _mesh_config(tmp_path, mesh_devices, pixel_path="rgb"):
     cfg = {
         "video_path_iterator":
             "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
@@ -28,6 +28,7 @@ def _mesh_config(tmp_path, mesh_devices):
              "queue_groups": [{"devices": [0], "out_queues": [0]}],
              "num_shared_tensors": 8,
              "raw_output": True,
+             "pixel_path": pixel_path,
              "max_clips": TINY["max_clips"],
              "consecutive_frames": TINY["consecutive_frames"],
              "num_clips_population": [1, 2],
@@ -37,6 +38,7 @@ def _mesh_config(tmp_path, mesh_devices):
              "queue_groups": [{"devices": [mesh_devices[0]],
                                "in_queue": 0}],
              "mesh_devices": mesh_devices,
+             "pixel_path": pixel_path,
              **TINY},
         ],
     }
@@ -83,6 +85,36 @@ def test_mesh_pipeline_end_to_end(tmp_path):
     assert res.throughput_vps > 0
     reports = [f for f in os.listdir(res.log_dir) if "group" in f]
     assert len(reports) == 1
+
+
+def test_mesh_pipeline_yuv_pixel_path(tmp_path):
+    """loader(raw packed 4:2:0) -> mesh stage whose sharded program
+    runs the fused yuv ingest — the pixel path composes with dp x sp
+    sharding end to end."""
+    cfg = _mesh_config(tmp_path, mesh_devices=[1, 2],
+                       pixel_path="yuv420")
+    res = run_benchmark(cfg, mean_interval_ms=0, num_videos=6,
+                        log_base=str(tmp_path / "logs"),
+                        print_progress=False, seed=0)
+    assert res.termination_flag == TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    assert res.throughput_vps > 0
+
+
+def test_mesh_stage_rejects_pixel_path_mismatch():
+    """A loader/mesh pixel_path disagreement must fail with a clear
+    error naming pixel_path, not a shape error inside shard_map."""
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DMeshRunner
+    from rnb_tpu.stage import PaddedBatch
+    from rnb_tpu.telemetry import TimeCard
+
+    stage = R2P1DMeshRunner(device=jax.devices()[0],
+                            mesh_devices=[0, 1], pixel_path="yuv420",
+                            **TINY)
+    rgb = np.zeros((TINY["max_clips"], TINY["consecutive_frames"],
+                    112, 112, 3), np.uint8)
+    with pytest.raises(ValueError, match="pixel_path"):
+        stage((PaddedBatch(rgb, 1),), None, TimeCard(0))
 
 
 def test_mesh_stage_pads_indivisible_clip_axis():

@@ -126,6 +126,47 @@ def test_sharded_inference_pads_indivisible_clip_axis():
     assert pad_logits.shape == (2, 16)
 
 
+def test_sharded_inference_yuv_pixel_path():
+    """The sharded program's fused yuv ingest: (a) sharded == less
+    sharded within the yuv path (exact same math), (b) on constant-
+    chroma content the yuv and rgb paths agree (chroma index choice is
+    the only difference between them)."""
+    import jax
+    from rnb_tpu.ops.yuv import packed_frame_bytes
+
+    hw = TINY.get("frame_hw", 32)
+    si_yuv = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:4], axes={"dp": 2, "sp": 2}),
+        pixel_path="yuv420", **TINY)
+    assert si_yuv.batch_shape(2)[-1] == packed_frame_bytes(hw, hw)
+    si_yuv1 = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:2], axes={"dp": 2, "sp": 1}),
+        pixel_path="yuv420", **TINY)
+    rng = np.random.default_rng(7)
+    c = TINY["max_clips"] if "max_clips" in TINY else 4
+    packed = rng.integers(0, 256, si_yuv.batch_shape(2), dtype=np.uint8)
+    valid = [c, max(1, c - 1)]
+    a = np.asarray(si_yuv.run(*si_yuv.place(packed, valid)))
+    b = np.asarray(si_yuv1.run(*si_yuv1.place(packed, valid)))
+    np.testing.assert_allclose(a, b, rtol=0, atol=0.1)
+
+    # constant chroma (128): yuv ingest must agree with the rgb path
+    si_rgb = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:2], axes={"dp": 2, "sp": 1}),
+        **TINY)
+    f = TINY["consecutive_frames"]
+    shape = si_yuv.batch_shape(2)
+    y_bytes = hw * hw
+    gray_packed = np.full(shape, 128, np.uint8)
+    y = rng.integers(0, 256, shape[:-1] + (y_bytes,), dtype=np.uint8)
+    gray_packed[..., :y_bytes] = y
+    # rgb equivalent: R=G=B=Y (BT.601 with u=v=128), same gather grid
+    rgb = np.repeat(y.reshape(2, -1, f, hw, hw, 1), 3, axis=-1)
+    got = np.asarray(si_yuv1.run(*si_yuv1.place(gray_packed, valid)))
+    want = np.asarray(si_rgb.run(*si_rgb.place(rgb, valid)))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0.1)
+
+
 def test_distributed_single_process_mode(monkeypatch):
     from rnb_tpu.parallel import distributed
     monkeypatch.delenv("RNB_TPU_COORDINATOR", raising=False)
