@@ -150,6 +150,28 @@ def test_sharded_inference_yuv_pixel_path():
     b = np.asarray(si_yuv1.run(*si_yuv1.place(packed, valid)))
     np.testing.assert_allclose(a, b, rtol=0, atol=0.1)
 
+    # the shipped mesh-yuv topology relies on clip padding (max_clips
+    # 15, sp 4 -> 16): exercise yuv with an INDIVISIBLE clip axis so
+    # the rank-generic pad branch is covered, against the divisible
+    # case on the same clips
+    si_pad = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:4], axes={"dp": 2, "sp": 2}),
+        pixel_path="yuv420", max_clips=3,
+        consecutive_frames=TINY["consecutive_frames"], frame_hw=hw,
+        num_classes=TINY["num_classes"],
+        layer_sizes=TINY["layer_sizes"])
+    assert si_pad.padded_clips == 4
+    packed3 = rng.integers(0, 256, si_pad.batch_shape(2), dtype=np.uint8)
+    ref3 = make_sharded_inference(
+        mesh=build_mesh(jax.devices()[:2], axes={"dp": 2, "sp": 1}),
+        pixel_path="yuv420", max_clips=3,
+        consecutive_frames=TINY["consecutive_frames"], frame_hw=hw,
+        num_classes=TINY["num_classes"],
+        layer_sizes=TINY["layer_sizes"])
+    got3 = np.asarray(si_pad.run(*si_pad.place(packed3, [3, 2])))
+    want3 = np.asarray(ref3.run(*ref3.place(packed3, [3, 2])))
+    np.testing.assert_allclose(got3, want3, rtol=0, atol=0.1)
+
     # constant chroma (128): yuv ingest must agree with the rgb path
     si_rgb = make_sharded_inference(
         mesh=build_mesh(jax.devices()[:2], axes={"dp": 2, "sp": 1}),
