@@ -50,8 +50,8 @@ exit; an external SIGKILL on a TPU-attached process is what wedges the
 tunnel in the first place) — retrying with backoff within a time
 budget.
 
-Env knobs: RNB_BENCH_VIDEOS (default 8000: ~12s measured window at
-the round-4 654 videos/s on
+Env knobs: RNB_BENCH_VIDEOS (default 10000: a >10s measured window at
+the round-4 fused flagship's ~900 videos/s on
 TPU), RNB_BENCH_CONFIG, RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk),
 RNB_BENCH_DATASET (y4m|synth, default y4m), RNB_TPU_DATA_ROOT (use an
 existing dataset instead of generating), RNB_BENCH_PLATFORM (e.g.
@@ -367,7 +367,7 @@ def main() -> int:
         if err:
             return _emit_error(err)
 
-    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "8000"))
+    num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "10000"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",
         os.path.join(repo_dir, "configs", "rnb-fused-yuv.json"))

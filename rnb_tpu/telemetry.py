@@ -79,9 +79,10 @@ class TimeCard:
         # on different devices).
         self.devices: List[tuple] = []
 
-    def record(self, key: str) -> None:
-        """Stamp event ``key`` with the current wall-clock time."""
-        self.timings[key] = time.time()
+    def record(self, key: str, at: Optional[float] = None) -> None:
+        """Stamp event ``key`` with the current wall-clock time (or a
+        caller-supplied instant, for events shared across cards)."""
+        self.timings[key] = time.time() if at is None else at
 
     def add_device(self, device_label: str) -> None:
         """Append a pipeline-step device visit to the trail."""
@@ -181,9 +182,14 @@ class TimeCardList:
     def __init__(self, time_cards: List[TimeCard]):
         self.time_cards = time_cards
 
-    def record(self, key: str) -> None:
+    def record(self, key: str, at: Optional[float] = None) -> None:
+        # one event, one instant: every constituent of a fused batch
+        # gets the SAME stamp (per-card time.time() calls would drift
+        # by microseconds, breaking offline dispatch-grouping — one
+        # fused jit call IS one event for all its constituents)
+        at = time.time() if at is None else at
         for tc in self.time_cards:
-            tc.record(key)
+            tc.record(key, at=at)
 
     def add_device(self, device_label: str) -> None:
         for tc in self.time_cards:

@@ -22,14 +22,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from parse_utils import decompose_latency, get_data_from_all_logs  # noqa: E402
+from parse_utils import (decompose_latency, dispatch_batch_sizes,  # noqa: E402
+                         get_data_from_all_logs)
 
 
 def summarize(log_base: str):
-    """-> DataFrame: one row per job, mean ms per latency component."""
+    """-> (jobs, per-job component means, per-request frame)."""
     jobs, requests = get_data_from_all_logs(log_base)
     if requests.empty:
-        return jobs, None
+        return jobs, None, requests
     requests = decompose_latency(requests)
     component_cols = [c for c in requests.columns
                       if c.startswith("gap:") or c in (
@@ -38,7 +39,7 @@ def summarize(log_base: str):
                           "neural_net")]
     grouped = requests.groupby(
         ["job_id", "mean_interval_ms"], as_index=False)[component_cols].mean()
-    return jobs, grouped
+    return jobs, grouped, requests
 
 
 def main(argv=None) -> int:
@@ -49,7 +50,7 @@ def main(argv=None) -> int:
                         help="Optional PNG path for the stacked-bar chart")
     args = parser.parse_args(argv)
 
-    jobs, grouped = summarize(args.log_base)
+    jobs, grouped, requests = summarize(args.log_base)
     if grouped is None or grouped.empty:
         print("No per-request timing tables found under %r" % args.log_base)
         return 1
@@ -65,8 +66,14 @@ def main(argv=None) -> int:
         # 2-stage job has no runner2 columns, which must read as
         # "absent", not poison the total with NaN
         total = sum(row[c] for c in component_cols if pd.notna(row[c]))
-        print("%s: total %.3f ms end-to-end mean latency" % (row["job_id"],
-                                                             total))
+        line = "%s: total %.3f ms end-to-end mean latency" % (
+            row["job_id"], total)
+        sub = requests[requests["job_id"] == row["job_id"]]
+        sizes = dispatch_batch_sizes(sub)
+        if not sizes.empty:
+            line += "  dispatch batch sizes: %s" % (
+                ", ".join("%dx%d" % (s, n) for s, n in sizes.items()))
+        print(line)
 
     if args.out:
         import matplotlib

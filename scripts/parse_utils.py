@@ -161,6 +161,38 @@ STANDARD_COMPONENTS = [
 ]
 
 
+def dispatch_batch_sizes(df: pd.DataFrame,
+                         step: Optional[int] = None) -> pd.Series:
+    """Batch-size distribution of the network dispatches.
+
+    Constituents of one fused dispatch (Batcher / R2P1DFusingLoader:
+    one jit call stamps every constituent card) share their
+    ``inference{step}_finish`` timestamp exactly, so grouping requests
+    by that stamp recovers how many requests each device dispatch
+    carried — the evidence for whether the batching strategy actually
+    fills dispatches under the measured load. ``step`` defaults to the
+    last inference step present. Returns size -> dispatch count.
+    """
+    # numeric sort (lexicographic would rank step 9 above step 10), and
+    # only columns with data — a union-schema frame carries all-NaN
+    # finish columns for jobs with shallower pipelines
+    finish_cols = sorted(
+        (c for c in df.columns
+         if re.fullmatch(r"inference\d+_finish", c)
+         and df[c].notna().any()),
+        key=lambda c: int(re.search(r"\d+", c).group()))
+    if step is not None:
+        col = "inference%d_finish" % step
+        if col not in df.columns:
+            raise ValueError("no %r column; have %r" % (col, finish_cols))
+    elif finish_cols:
+        col = finish_cols[-1]
+    else:
+        return pd.Series(dtype=int)
+    sizes = df.groupby(df[col]).size()
+    return sizes.value_counts().sort_index()
+
+
 def decompose_latency(df: pd.DataFrame) -> pd.DataFrame:
     """Add per-request latency-component columns (milliseconds).
 

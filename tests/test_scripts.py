@@ -86,6 +86,31 @@ def test_decompose_latency_standard_schema(tmp_path):
         assert df[col].iloc[0] == pytest.approx(500.0), col
 
 
+def test_dispatch_batch_sizes(tmp_path):
+    """Requests sharing an inference-finish stamp = one fused dispatch;
+    the distribution recovers fused batch sizes from the logs."""
+    from parse_utils import dispatch_batch_sizes, parse_timing_table
+    keys = ["enqueue_filename", "runner0_start", "inference0_start",
+            "inference0_finish"]
+    summary = TimeCardSummary()
+    t = 500.0
+    # two fused dispatches of 3, one single: stamps shared per dispatch
+    for dispatch, size in enumerate((3, 3, 1)):
+        finish = t + dispatch
+        for _ in range(size):
+            tc = TimeCard(0)
+            for k_idx, key in enumerate(keys[:-1]):
+                tc.timings[key] = finish - 0.1 * (len(keys) - k_idx)
+            tc.timings["inference0_finish"] = finish
+            tc.add_device("tpu0")
+            summary.register(tc)
+    path = logname("job-f", "tpu0", 0, 0, base=str(tmp_path))
+    with open(path, "w") as f:
+        summary.save_full_report(f)
+    sizes = dispatch_batch_sizes(parse_timing_table(path))
+    assert sizes.to_dict() == {1: 1, 3: 2}
+
+
 def test_latency_summary_cli(tmp_path, capsys):
     _make_job(str(tmp_path), "job-a")
     import latency_summary

@@ -104,6 +104,10 @@ def test_timecardlist_broadcasts():
     for tc in cards:
         assert "evt" in tc.timings
         assert tc.devices == [("cpu:0",)]
+    # one fused event is ONE instant: identical stamp on every
+    # constituent (offline analysis groups dispatches by it)
+    stamps = {tc.timings["evt"] for tc in cards}
+    assert len(stamps) == 1
     with pytest.raises(NotImplementedError):
         lst.fork(0)
 
