@@ -20,6 +20,7 @@ Capability parity with the reference stage library
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
@@ -497,7 +498,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         """Move decode-complete requests from in-flight to ready,
         preserving FIFO order (a slow head occupies the whole pool
         anyway, so out-of-order harvest buys nothing)."""
-        import time
         while self._inflight and self._inflight[0][0].ready:
             handle, video, tc = self._inflight.popleft()
             self._ready.append((handle, video, tc, time.monotonic()))
@@ -539,7 +539,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         only fire on the NEXT arrival and would pay a full
         inter-arrival gap instead of max_hold_ms (+ the executor's
         poll granularity). Returns an emission or None."""
-        import time
         self._harvest()
         if not self._ready:
             return None
@@ -553,7 +552,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         return None
 
     def __call__(self, tensors, non_tensors, time_card):
-        import time
         handle = self.submit(non_tensors, time_card)
         self._inflight.append((handle, str(non_tensors), time_card))
         out = self.poll()  # harvest + the emission rules
@@ -575,7 +573,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         while self._inflight:
             handle, video, tc = self._inflight.popleft()
             handle.wait(video)
-            import time
             self._ready.append((handle, video, tc, time.monotonic()))
         if not self._ready:
             return None

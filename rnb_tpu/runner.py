@@ -191,6 +191,8 @@ def runner(ctx: RunnerContext) -> None:
             progress_bar = None
 
     ring_counter = 0  # next output slot (reference runner.py:60-61)
+    # accumulator stages expose poll() for the idle tick; resolve once
+    idle_poll = getattr(model, "poll", None)
     old_counter_value = 0
 
     # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
@@ -259,7 +261,6 @@ def runner(ctx: RunnerContext) -> None:
                         # for the NEXT arrival, paying a full
                         # inter-arrival gap instead of max_hold_ms
                         # (+<= QUEUE_POLL_S of poll granularity)
-                        idle_poll = getattr(model, "poll", None)
                         if idle_poll is None:
                             continue
                         flushed = idle_poll()

@@ -20,6 +20,8 @@ import argparse
 import os
 import sys
 
+import pandas as pd
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from parse_utils import (decompose_latency, dispatch_batch_sizes,  # noqa: E402
@@ -60,7 +62,6 @@ def main(argv=None) -> int:
     print(grouped.to_string(index=False,
                             float_format=lambda v: "%.3f" % v))
     print()
-    import pandas as pd
     for _, row in grouped.iterrows():
         # jobs are grouped over the UNION of every job's schema: a
         # 2-stage job has no runner2 columns, which must read as

@@ -183,12 +183,23 @@ def dispatch_batch_sizes(df: pd.DataFrame,
         key=lambda c: int(re.search(r"\d+", c).group()))
     if step is not None:
         col = "inference%d_finish" % step
-        if col not in df.columns:
-            raise ValueError("no %r column; have %r" % (col, finish_cols))
-    elif finish_cols:
-        col = finish_cols[-1]
+        if col not in df.columns or not df[col].notna().any():
+            raise ValueError("no data for %r; columns with data: %r"
+                             % (col, finish_cols))
     else:
-        return pd.Series(dtype=int)
+        if not finish_cols:
+            return pd.Series(dtype=int)
+        last_plain = int(re.search(r"\d+", finish_cols[-1]).group())
+        # segment-parallel jobs carry SUFFIXED merged keys
+        # ('inference1_finish-0', telemetry merge) for their deeper
+        # steps; grouping a pre-fork stage's stamps would mislabel
+        # per-request loader stamps as 'dispatch sizes', so refuse the
+        # default rather than mislead
+        if any(re.fullmatch(r"inference(\d+)_finish-\d+", c)
+               and int(re.search(r"\d+", c).group()) > last_plain
+               for c in df.columns):
+            return pd.Series(dtype=int)
+        col = finish_cols[-1]
     sizes = df.groupby(df[col]).size()
     return sizes.value_counts().sort_index()
 

@@ -110,6 +110,17 @@ def test_dispatch_batch_sizes(tmp_path):
     sizes = dispatch_batch_sizes(parse_timing_table(path))
     assert sizes.to_dict() == {1: 1, 3: 2}
 
+    df = parse_timing_table(path)
+    # explicit missing/empty step must raise, not return empty
+    with pytest.raises(ValueError):
+        dispatch_batch_sizes(df, step=7)
+    # a segment job's deeper steps carry suffixed merged keys; the
+    # default must refuse rather than mislabel a pre-fork stage
+    df["inference1_finish-0"] = df["inference0_finish"] + 1.0
+    assert dispatch_batch_sizes(df).empty
+    # but an explicit plain step still works
+    assert dispatch_batch_sizes(df, step=0).to_dict() == {1: 1, 3: 2}
+
 
 def test_latency_summary_cli(tmp_path, capsys):
     _make_job(str(tmp_path), "job-a")
