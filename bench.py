@@ -4,7 +4,7 @@ Reproduces the reference's benchmark methodology (SURVEY.md §6) on this
 framework, driven in bulk (max-throughput) mode against the baseline
 from the reference's only published number (11.3 videos/s on one GPU
 over config/r2p1d-whole.json, reference README.md:176-178). The default
-topology here is ``configs/rnb-fused-yuv.json`` — the reference's
+topology here is ``configs/rnb-fused-yuv-big.json`` — the reference's
 Replicate & Batch idea collapsed into the loader: R2P1DFusingLoader
 submits every request to the decode pool on receipt, harvests
 completed decodes and ships one fused device batch straight to the
@@ -14,6 +14,14 @@ Batching without the extra host stage that made the standalone Batcher
 topology host-bound (rnb-1chip measured 481 vs 874-909 fused in round
 4); the 2-stage ``r2p1d-whole-yuv`` and the reference-shaped
 ``rnb-1chip`` remain measured side-by-side in scripts/bench_matrix.py.
+The ``-big`` variant (fuse 20 / 48-row cap, buckets [6,15,24,36,48])
+exists because the tunnel's per-dispatch round-trip varies ~10x across
+transport phases (RESULTS.md, 2026-07-30): with ~9ms effective per
+dispatch the 15-row cap throttled the chip to 273 videos/s while the
+identical code had measured 869-909 in the low-RTT phase; 48-row
+fused dispatches recovered 2.1x (562) in the degraded phase and cost
+nothing in the warm one (adaptive emission still sends small batches
+the moment the pipeline idles).
 
 **Real decode by default.** The reference's number includes real video
 decode through NVVL (reference models/r2p1d/model.py:140-151), so this
@@ -370,7 +378,7 @@ def main() -> int:
     num_videos = int(os.environ.get("RNB_BENCH_VIDEOS", "10000"))
     config = os.environ.get(
         "RNB_BENCH_CONFIG",
-        os.path.join(repo_dir, "configs", "rnb-fused-yuv.json"))
+        os.path.join(repo_dir, "configs", "rnb-fused-yuv-big.json"))
     mean_interval = int(os.environ.get("RNB_BENCH_MEAN_INTERVAL_MS", "0"))
 
     # the probe leaves one gap: the tunnel can wedge *between* the
