@@ -253,3 +253,67 @@ def test_fused_pipeline_end_to_end(tmp_path):
         assert res.num_videos == 9
     finally:
         os.environ.pop("RNB_TPU_DATA_ROOT", None)
+
+
+def test_wide_caps_bucket_and_conserve(tmp_path):
+    """Wide-dispatch caps (configs/rnb-fused-yuv-big/-mid): fused rows
+    never exceed max_clips, every emission pads to the smallest bucket
+    that fits, and no request/clip is lost. Emission *sizes* here are
+    timing-dependent (decode may outrun the submit loop and trigger
+    nothing-in-flight partial emits), so this test asserts only the
+    invariants that hold for every emission; the deterministic
+    per-size cases live in test_flush_take_hits_exact_buckets."""
+    paths = _dataset(tmp_path, n=15)
+    loader = _loader(fuse=12, max_hold_ms=1e9, depth=100,
+                     max_clips=36, row_buckets=[6, 15, 24, 36],
+                     num_clips_population=[3], weights=[1])
+    emitted = []
+    for i, p in enumerate(paths):
+        out = loader(None, p, TimeCard(i))
+        if out[2] is not None:
+            emitted.append(out)
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        emitted.append(out)
+    total_reqs = sum(len(tc) for _, _, tc in emitted)
+    total_rows = sum(pb.valid for (pb,), _, tc in emitted)
+    assert total_reqs == 15
+    assert total_rows == 45  # 15 requests x 3 clips, none lost
+    for (pb,), _, cards in emitted:
+        assert pb.valid <= 36  # cap respected
+        assert pb.data.shape[0] in (6, 15, 24, 36)  # a real bucket
+        # smallest bucket that fits the valid rows — no over-padding
+        fitting = [b for b in (6, 15, 24, 36) if b >= pb.valid]
+        assert pb.data.shape[0] == fitting[0], (pb.valid,
+                                                pb.data.shape[0])
+
+
+def test_flush_take_hits_exact_buckets(tmp_path):
+    """Deterministic bucket selection for wide caps. Submits bypass
+    __call__ (whose poll can emit early whenever decode outruns the
+    loop) and go straight into the in-flight window, so flush() —
+    which retires every decode, then takes exactly ``fuse`` requests
+    per call — produces known emission sizes. The case this pins: a
+    24-row fusion must ship the 24-row bucket, not the 36-row cap."""
+    paths = _dataset(tmp_path, n=15)
+    for fuse, want in ((8, [(24, 24), (21, 24)]),
+                       (12, [(36, 36), (9, 15)])):
+        loader = _loader(fuse=fuse, max_hold_ms=1e9, depth=100,
+                         max_clips=36, row_buckets=[6, 15, 24, 36],
+                         num_clips_population=[3], weights=[1])
+        for i, p in enumerate(paths):
+            tc = TimeCard(i)
+            handle = loader.submit(p, tc)
+            loader._inflight.append((handle, p, tc))
+        got = []
+        while True:
+            out = loader.flush()
+            if out is None:
+                break
+            (pb,), _, cards = out
+            got.append((pb.valid, pb.data.shape[0]))
+        # fuse=8: takes of 8, 7(=15-8) requests x 3 clips + remainder
+        # rows 24->bucket 24 (NOT 36), 21->24, 9->15
+        assert got == want, (fuse, got)
