@@ -46,6 +46,12 @@ def _cells(poisson_mi: int):
         ("configs/rnb-1chip-yuv.json", 0),
         ("configs/rnb-fused-yuv.json", 0),
         ("configs/rnb-fused-yuv.json", poisson_mi),
+        # the fused-dispatch cap sweep (RESULTS.md "The cap sweep"):
+        # -mid is the latency-SLO point, -big the bulk headline default
+        ("configs/rnb-fused-yuv-mid.json", 0),
+        ("configs/rnb-fused-yuv-mid.json", poisson_mi),
+        ("configs/rnb-fused-yuv-big.json", 0),
+        ("configs/rnb-fused-yuv-big.json", poisson_mi),
         ("configs/r2p1d-nopipeline-1chip.json", 0),
         ("configs/r2p1d-split-1chip.json", 0),
     ]
