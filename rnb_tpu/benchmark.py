@@ -205,8 +205,25 @@ def run_benchmark(config_path: str,
             if time.time() > deadline:
                 break  # let sta_bar.wait() raise the real timeout
             time.sleep(0.01)
+        # Window markers: a uniquely named jitted no-op dispatched at
+        # window start and end. Its module name lands in the device
+        # trace ON THE DEVICE'S OWN CLOCK, delimiting the measured
+        # window without any host-epoch mapping — necessary because
+        # the remote (axon) xplane timeline is session-scoped and its
+        # tick rate is not host-nanoseconds (observed ~4.3x wall), so
+        # epoch arithmetic cannot locate the window. Compiled here,
+        # BEFORE capture starts, so no compile lands in the trace.
+        import jax
+
+        def rnb_window_marker(x):
+            return x + 1
+
+        _marker = jax.jit(rnb_window_marker)
+        _marker_arg = jax.numpy.zeros((3, 91), jax.numpy.float32)
+        jax.block_until_ready(_marker(_marker_arg))
         profiler.initialize(os.path.join(logroot(job_id, base=log_base),
                                          "xprof"))
+        jax.block_until_ready(_marker(_marker_arg))
     sta_bar.wait()
     time_start = time.time()
     if print_progress:
@@ -216,13 +233,36 @@ def run_benchmark(config_path: str,
     time_end = time.time()
     total_time = time_end - time_start
     if xprof:
-        from rnb_tpu import profiler
+        jax.block_until_ready(_marker(_marker_arg))  # end-of-window mark
+        # anchor BEFORE stop_trace: stopping pulls the whole trace
+        # through the tunnel (measured ~70 s for 265k events), so an
+        # after-the-fact stamp would place the device timeline's end
+        # over a minute past the last captured op. Taken here, the
+        # stamp coincides with the device's last ops up to the short
+        # post-window drain (EOS flush dispatches), which biases the
+        # mapped window late by at most that drain.
+        flush_epoch = time.time()
         profiler.flush()
-        ops = profiler.report(keep_trace=True)
+        ops = profiler.report(keep_trace=True, include_plane=True)
         with open(os.path.join(logroot(job_id, base=log_base),
                                "xprof-ops.txt"), "w") as f:
-            for name, t0, t1 in ops:
-                f.write("%d %d %s\n" % (t0, t1, name))
+            # per-plane clock bases differ (XLine timestamps have no
+            # shared origin across host/device planes), so the plane
+            # is part of the record: busy-time aggregation is only
+            # valid within one plane (scripts/device_busy.py groups).
+            f.write("# t0_ns t1_ns plane op_name\n")
+            # The axon/remote xplane contains the device's whole
+            # session, not just [start_trace, stop_trace] (observed:
+            # 52 s of device timeline for a 4.4 s measured window), so
+            # the measured window is recorded in host epoch; the
+            # analyzer maps it into device clock by anchoring
+            # flush_epoch to the last device timestamp.
+            f.write("# window_epoch %f %f flush_epoch %f\n"
+                    % (time_start, time_end, flush_epoch))
+            for name, t0, t1, plane in ops:
+                f.write("%d %d %s %s\n"
+                        % (t0, t1, plane.replace(" ", "_") or "-",
+                           name))
         if print_progress:
             print("xprof: %d device-op intervals -> xprof-ops.txt"
                   % len(ops))

@@ -28,9 +28,11 @@ import os
 import shutil
 import tempfile
 import threading
-from typing import List, Optional, Tuple
+from typing import List, Optional, Tuple, Union
 
 Interval = Tuple[str, int, int]
+#: internal parse shape; surfaced by ``report(include_plane=True)``
+PlaneInterval = Tuple[str, int, int, str]
 
 _state_lock = threading.Lock()
 _trace_dir: Optional[str] = None
@@ -108,7 +110,8 @@ def _xplane_files() -> List[str]:
                      "*.xplane.pb")))
 
 
-def _parse_native(lib, path: str, plane_filter: str) -> List[Interval]:
+def _parse_native(lib, path: str, plane_filter: str) \
+        -> List[PlaneInterval]:
     handle = lib.rnb_xplane_load(path.encode(),
                                  plane_filter.encode())
     if not handle:
@@ -118,9 +121,11 @@ def _parse_native(lib, path: str, plane_filter: str) -> List[Interval]:
         out = []
         for i in range(n):
             name = lib.rnb_xplane_event_name(handle, i)
+            plane = lib.rnb_xplane_event_plane(handle, i)
             out.append((name.decode("utf-8", "replace"),
                         int(lib.rnb_xplane_event_start_ns(handle, i)),
-                        int(lib.rnb_xplane_event_end_ns(handle, i))))
+                        int(lib.rnb_xplane_event_end_ns(handle, i)),
+                        plane.decode("utf-8", "replace")))
         return out
     finally:
         lib.rnb_xplane_free(handle)
@@ -172,10 +177,11 @@ def _fields(buf: bytes):
             raise ValueError("bad wire type %d" % wire)
 
 
-def _parse_python(path: str, plane_filter: str) -> List[Interval]:
+def _parse_python(path: str, plane_filter: str) \
+        -> List[PlaneInterval]:
     # Degrade like the native parser on malformed input: return what
     # was decoded before the corruption instead of raising.
-    out: List[Interval] = []
+    out: List[PlaneInterval] = []
     try:
         _parse_python_into(path, plane_filter, out)
     except (IndexError, ValueError):
@@ -184,7 +190,7 @@ def _parse_python(path: str, plane_filter: str) -> List[Interval]:
 
 
 def _parse_python_into(path: str, plane_filter: str,
-                       out: List[Interval]) -> None:
+                       out: List[PlaneInterval]) -> None:
     with open(path, "rb") as f:
         data = f.read()
     for field, plane in _fields(data):
@@ -233,12 +239,13 @@ def _parse_python_into(path: str, plane_filter: str,
                         dur_ps = v3
                 start = ts_ns + off_ps // 1000
                 out.append((names.get(mid, "metadata:%d" % mid), start,
-                            start + dur_ps // 1000))
-    return out
+                            start + dur_ps // 1000, plane_name))
 
 
 def report(plane_filter: Optional[str] = None,
-           keep_trace: bool = False) -> List[Interval]:
+           keep_trace: bool = False,
+           include_plane: bool = False) \
+        -> Union[List[Interval], List[PlaneInterval]]:
     """-> captured ``[(op_name, start_ns, end_ns)]``; clears state.
 
     ``plane_filter`` keeps only planes whose name contains the string.
@@ -246,11 +253,19 @@ def report(plane_filter: Optional[str] = None,
     smoke test runs on TPU and on the CPU test backend).  Like the
     reference's ``report()`` (utils/cupti.cpp:160-166) this drains:
     captured trace files are deleted unless ``keep_trace``.
+
+    ``include_plane`` appends the owning plane name to each tuple —
+    ``(op_name, start_ns, end_ns, plane)``. Timestamps are only
+    mutually comparable WITHIN a plane: XLine bases differ across
+    planes (a host-threads plane and a device plane do not share a
+    clock origin), so any busy-time union over a multi-plane interval
+    list conflates clocks. Consumers that aggregate (device_busy.py)
+    must group by plane first.
     """
     global _trace_dir
     files = _xplane_files()
     lib = _xplane_lib()
-    intervals: List[Interval] = []
+    intervals = []
     for path in files:
         if plane_filter is not None:
             wanted = [plane_filter]
@@ -263,6 +278,8 @@ def report(plane_filter: Optional[str] = None,
                 got = (_parse_native(lib, path, "") if lib is not None
                        else _parse_python(path, ""))
             intervals.extend(got)
+    if not include_plane:
+        intervals = [(name, t0, t1) for name, t0, t1, _plane in intervals]
     intervals.sort(key=lambda t: t[1])
     with _state_lock:
         if not keep_trace and _trace_dir and not _capturing:

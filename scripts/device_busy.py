@@ -17,6 +17,20 @@ An analytic MFU of X% with a device-busy fraction well above X% means
 the gap is kernel inefficiency (small batches, layout); busy fraction
 near X% means the chip is compute-bound and X% is the honest ceiling
 for this topology.
+
+Known limitation, measured on the axon remote-TPU transport
+(2026-07-30): the vm-side xplane is SESSION-scoped (start/stop_trace
+do not bound it), its tick rate is not host nanoseconds (observed
+~4.3x wall), and its event timestamps are not session-chronological
+(the window-marker ops land at the trace's extremes while op density
+is uniform) — so window-scoped busy fractions are not recoverable
+there and the full-span fraction under-reports steady-state
+utilization. Per-op accumulated durations remain valid relative
+measures (same tick scale); dividing total busy by the observed tick
+ratio reproduced the analytic MFU within noise (8.2 s busy / 4.3 over
+a 4.1 s window ~ 46% vs ~34% MFU + copies). On backends whose traces
+honor capture bounds, the marker window (preferred) or the epoch
+header (fallback) scopes the report to the measured window.
 """
 
 from __future__ import annotations
@@ -24,6 +38,10 @@ from __future__ import annotations
 import argparse
 import sys
 from collections import defaultdict
+
+#: matches rnb_tpu.profiler.DEVICE_PLANE_MARKER (kept local: this script
+#: must run without importing jax)
+DEVICE_PLANE_MARKER = "/device:"
 
 
 def is_device_op(name: str) -> bool:
@@ -36,18 +54,107 @@ def is_device_op(name: str) -> bool:
 
 
 def load_intervals(path: str, device_only: bool = True):
-    """-> [(t0_ns, t1_ns, name)] from an xprof-ops.txt file."""
-    out = []
+    """-> {plane: [(t0_ns, t1_ns, name)]} from an xprof-ops.txt file.
+
+    Two formats: the current 4-column ``t0 t1 plane name`` (marked by
+    a ``# t0_ns t1_ns plane op_name`` header) and the legacy 3-column
+    ``t0 t1 name``, which lands under the single plane ``"(all)"``.
+    Per-plane grouping matters: XLine clock bases differ across planes,
+    so a busy-time union across planes conflates clocks (observed as a
+    54 s "span" for a 6 s capture before the format carried the plane).
+    """
+    out = {}
     with open(path) as f:
+        first = f.readline()
+        four_col = first.startswith("#") and "plane" in first
+        if not first.startswith("#"):
+            f.seek(0)
         for line in f:
-            parts = line.rstrip("\n").split(" ", 2)
-            if len(parts) != 3:
+            if line.startswith("#"):
                 continue
-            t0, t1, name = parts
+            if four_col:
+                parts = line.rstrip("\n").split(" ", 3)
+                if len(parts) != 4:
+                    continue
+                t0, t1, plane, name = parts
+            else:
+                parts = line.rstrip("\n").split(" ", 2)
+                if len(parts) != 3:
+                    continue
+                t0, t1, name = parts
+                plane = "(all)"
             if device_only and not is_device_op(name):
                 continue
-            out.append((int(t0), int(t1), name))
+            out.setdefault(plane, []).append((int(t0), int(t1), name))
     return out
+
+
+def load_window(path: str):
+    """-> (window_t0_epoch, window_t1_epoch, flush_epoch) or None.
+
+    Written by ``rnb_tpu.benchmark --xprof`` as a header comment. The
+    trace's device clock has no relation to host epoch and (on remote
+    backends) the capture covers the device's whole session, warmup
+    included — so the measured window travels as host epochs plus the
+    flush time, and :func:`clip_to_window` maps it onto the device
+    timeline by anchoring flush_epoch to the last device timestamp.
+    """
+    with open(path) as f:
+        for line in f:
+            if not line.startswith("#"):
+                return None
+            parts = line.split()
+            if "window_epoch" in parts and "flush_epoch" in parts:
+                i = parts.index("window_epoch")
+                j = parts.index("flush_epoch")
+                return (float(parts[i + 1]), float(parts[i + 2]),
+                        float(parts[j + 1]))
+    return None
+
+
+MARKER = "rnb_window_marker"
+
+
+def marker_window(intervals):
+    """-> (w0_ns, w1_ns) from the window-marker ops, or None.
+
+    ``rnb_tpu.benchmark --xprof`` dispatches a jitted no-op named
+    ``rnb_window_marker`` right before releasing the start barrier and
+    right after the finish barrier. Those events carry the device's
+    own clock, so the window needs no host-epoch mapping (the remote
+    xplane timeline is session-scoped and its tick rate is not
+    host-ns). Window = end of the first marker to start of the last;
+    needs at least two marker events.
+    """
+    marks = sorted((t0, t1) for t0, t1, n in intervals if MARKER in n)
+    if len(marks) < 2:
+        return None
+    w0, w1 = marks[0][1], marks[-1][0]
+    if w1 <= w0:
+        # non-chronological timestamps (see module docstring): the
+        # markers cannot delimit anything; let the caller fall back
+        return None
+    return w0, w1
+
+
+def clip_to_window(intervals, window, anchor_t1_ns: int):
+    """Clip one plane's intervals to the measured window.
+
+    ``anchor_t1_ns`` (the plane's max t1) is assumed to coincide with
+    ``flush_epoch``; under bulk load the device is busy until moments
+    before the controller stops the clock, so the alignment error is
+    the drain+flush time (tens of ms), small against multi-second
+    windows. Returns (clipped_intervals, (w0_ns, w1_ns)).
+    """
+    t0_epoch, t1_epoch, flush_epoch = window
+    w0 = anchor_t1_ns - int((flush_epoch - t0_epoch) * 1e9)
+    w1 = anchor_t1_ns - int((flush_epoch - t1_epoch) * 1e9)
+    out = []
+    for t0, t1, name in intervals:
+        if t1 <= w0 or t0 >= w1:
+            continue
+        out.append((max(t0, w0), min(t1, w1), name))
+    return out, (w0, w1)
 
 
 def merged_busy_ns(intervals) -> int:
@@ -106,22 +213,78 @@ def main(argv=None) -> int:
     if not everything:
         print("no intervals in %s" % args.trace)
         return 1
-    bounds = (min(t0 for t0, _t1, _n in everything),
-              max(t1 for _t0, t1, _n in everything))
-    stats = summarize(
-        load_intervals(args.trace,
-                       device_only=not args.include_host),
-        args.top, span_bounds=bounds)
-    if not stats["ops"]:
+    # plane-aware device selection: when the trace names /device:
+    # planes, those ARE the device ops — the name heuristic only has
+    # to carry legacy 3-column traces (one anonymous "(all)" plane)
+    device_planes = {p for p in everything if DEVICE_PLANE_MARKER in p}
+    kept = {}
+    for plane, ivals in everything.items():
+        if not args.include_host:
+            if device_planes:
+                if plane not in device_planes:
+                    continue
+            else:
+                ivals = [iv for iv in ivals if is_device_op(iv[2])]
+        if ivals:
+            kept[plane] = ivals
+    if not kept:
         print("no device-op intervals in %s" % args.trace)
         return 1
-    print("device-op intervals : %d" % stats["ops"])
-    print("trace span          : %.3f ms" % stats["span_ms"])
-    print("device busy (union) : %.3f ms  (%.1f%% of span)"
-          % (stats["busy_ms"], 100.0 * stats["busy_fraction"]))
-    print("top ops by accumulated device time:")
-    for name, ns in stats["top_ops"]:
-        print("  %10.3f ms  %s" % (ns / 1e6, name[:90]))
+    # one block per plane, busiest first; spans NEVER cross planes
+    # (clock bases differ), so each block is internally consistent
+    blocks = []
+    for plane, intervals in kept.items():
+        allp = everything[plane]
+        bounds = (min(t0 for t0, _t1, _n in allp),
+                  max(t1 for _t0, t1, _n in allp))
+        blocks.append((plane, summarize(intervals, args.top,
+                                        span_bounds=bounds)))
+    blocks.sort(key=lambda b: -b[1]["busy_ms"])
+    window = load_window(args.trace)
+    for plane, stats in blocks:
+        print("plane               : %s" % plane)
+        print("device-op intervals : %d" % stats["ops"])
+        print("trace span          : %.3f ms" % stats["span_ms"])
+        print("device busy (union) : %.3f ms  (%.1f%% of span)"
+              % (stats["busy_ms"], 100.0 * stats["busy_fraction"]))
+        # the honest MFU cross-check: busy fraction of the MEASURED
+        # window only (the full trace also contains warmup and any
+        # pre-capture session activity). Preferred: the in-trace
+        # window markers (device clock, no mapping); fallback: the
+        # host-epoch header, valid only where the trace timeline is
+        # wall-clock ns anchored at the capture stop.
+        mwin = marker_window(everything[plane])
+        if mwin is not None:
+            rows = [iv for iv in kept[plane] if MARKER not in iv[2]]
+            clipped = [(max(t0, mwin[0]), min(t1, mwin[1]), n)
+                       for t0, t1, n in rows
+                       if t1 > mwin[0] and t0 < mwin[1]]
+            wstats = summarize(clipped, 0, span_bounds=mwin)
+            if wstats["ops"]:
+                print("measured window     : busy %.3f ms of the "
+                      "marker-delimited window (%.1f%%; device-clock "
+                      "units)"
+                      % (wstats["busy_ms"],
+                         100.0 * wstats["busy_fraction"]))
+            else:
+                print("measured window     : no device ops between "
+                      "the markers")
+        elif window is not None:
+            anchor = max(t1 for _t0, t1, _n in everything[plane])
+            clipped, (w0, w1) = clip_to_window(kept[plane], window,
+                                               anchor)
+            wstats = summarize(clipped, 0, span_bounds=(w0, w1))
+            if wstats["ops"]:
+                print("measured window     : %.3f ms  busy %.3f ms "
+                      "(%.1f%% of window)"
+                      % (wstats["span_ms"], wstats["busy_ms"],
+                         100.0 * wstats["busy_fraction"]))
+            else:
+                print("measured window     : no device ops in window")
+        print("top ops by accumulated device time:")
+        for name, ns in stats["top_ops"]:
+            print("  %10.3f ms  %s" % (ns / 1e6, name[:90]))
+        print()
     return 0
 
 

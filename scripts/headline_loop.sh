@@ -10,20 +10,20 @@
 cd "$(dirname "$0")/.." || exit 1
 ATTEMPTS=${1:-20}
 SLEEP_S=${2:-600}
+OUT=$(mktemp /tmp/headline_attempt.XXXXXX.json)
+trap 'rm -f "$OUT"' EXIT
 for i in $(seq 1 "$ATTEMPTS"); do
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   RNB_BENCH_INIT_BUDGET_S=${RNB_BENCH_INIT_BUDGET_S:-300} \
   RNB_BENCH_PROBE_TIMEOUT_S=${RNB_BENCH_PROBE_TIMEOUT_S:-75} \
   RNB_BENCH_RUN_BUDGET_S=${RNB_BENCH_RUN_BUDGET_S:-1200} \
-    python bench.py >/tmp/headline_attempt.json 2>/tmp/headline_attempt.err
+    python bench.py >"$OUT" 2>"${OUT%.json}.err"
   rc=$?
-  line=$(head -1 /tmp/headline_attempt.json)
-  [ -z "$line" ] && line='null'
-  python - "$ts" "$rc" <<'EOF'
+  python - "$ts" "$rc" "$OUT" <<'EOF'
 import json, sys
-ts, rc = sys.argv[1], int(sys.argv[2])
+ts, rc, out = sys.argv[1], int(sys.argv[2]), sys.argv[3]
 try:
-    result = json.load(open("/tmp/headline_attempt.json"))
+    result = json.load(open(out))
 except Exception:
     result = None
 with open("BENCH_ATTEMPTS.jsonl", "a") as f:

@@ -40,6 +40,18 @@ def test_report_returns_intervals(captured):
         assert t1 >= t0 >= 0
 
 
+def test_report_include_plane(captured):
+    """include_plane=True appends the owning plane to every tuple and
+    matches the 3-tuple form element-for-element (same parse, plane
+    stripped vs kept)."""
+    with_plane = profiler.report(keep_trace=True, include_plane=True)
+    bare = profiler.report(keep_trace=True)
+    assert with_plane and bare
+    assert [(n, t0, t1) for n, t0, t1, _p in with_plane] == bare
+    planes = {p for _n, _t0, _t1, p in with_plane}
+    assert all(isinstance(p, str) and p for p in planes), planes
+
+
 def test_native_and_python_parsers_agree(captured):
     files = profiler._xplane_files()
     assert files, "no xplane.pb produced"
