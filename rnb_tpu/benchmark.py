@@ -97,6 +97,11 @@ def run_benchmark(config_path: str,
 
     config = load_config(config_path)
     config.check_devices()
+    # best-effort contention probe (reference benchmark.py:97-125
+    # aborted here; we warn — see rnb_tpu.devices.probe_busy_devices)
+    from rnb_tpu.devices import probe_busy_devices
+    for warning in probe_busy_devices(config.all_devices()):
+        print("[rnb-tpu] WARNING: %s" % warning, file=sys.stderr)
 
     if job_id is None:
         job_id = "%s-mi%d-b%d-v%d-qs%d" % (

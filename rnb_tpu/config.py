@@ -31,6 +31,11 @@ RESERVED_KEYWORDS = [
     "async_dispatch",
 ]
 
+#: Ring slots per stage instance when a step omits 'num_shared_tensors'
+#: (reference control.py:8). Lives here (not control.py) so validation
+#: can check the effective slot count at parse time.
+DEFAULT_NUM_SHARED_TENSORS = 10
+
 DEFAULT_QUEUE_SELECTOR = "rnb_tpu.selector.RoundRobinSelector"
 
 
@@ -71,6 +76,14 @@ class StepConfig:
     #: publish outputs without blocking on device completion (timing
     #: then measures dispatch, not compute — see rnb_tpu.runner)
     async_dispatch: bool = False
+
+    @property
+    def effective_shared_tensors(self) -> int:
+        """Ring slots per producer instance after defaulting — the single
+        definition both validation and ChannelFabric allocation use."""
+        return (self.num_shared_tensors
+                if self.num_shared_tensors is not None
+                else DEFAULT_NUM_SHARED_TENSORS)
 
     def kwargs_for_group(self, group_idx: int) -> Dict[str, Any]:
         """Model-constructor kwargs: step extras overridden by group extras
@@ -157,6 +170,26 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     % where)
             _expect(not final,
                     "the last step does not need shared output tensors")
+
+        # A producer writes every segment of a batch into its own ring
+        # slot before publishing any Signal (runner.py), so a ring with
+        # fewer slots than segments blocks forever on a slot whose
+        # consumer was never told about it — a silent self-deadlock the
+        # 1800 s barrier timeout would otherwise be the first sign of.
+        # Deliberately conservative: a ring-less step (output_shape None,
+        # knowable only after loading the model class — which parse-time
+        # validation must not do) cannot deadlock, but is still rejected
+        # here; declare num_shared_tensors >= num_segments to get past
+        # (harmless when no ring is allocated).
+        effective_slots = (num_shared_tensors if num_shared_tensors is not None
+                           else DEFAULT_NUM_SHARED_TENSORS)
+        _expect(num_segments <= effective_slots,
+                "%s: 'num_segments' (%d) exceeds the shared-tensor ring "
+                "size (%d%s) — the producer would deadlock waiting on a "
+                "slot it has not yet published; raise 'num_shared_tensors'"
+                % (where, num_segments, effective_slots,
+                   "" if num_shared_tensors is not None
+                   else ", the default"))
 
         groups: List[GroupConfig] = []
         for group_idx, group_raw in enumerate(groups_raw):

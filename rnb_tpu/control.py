@@ -35,12 +35,10 @@ import threading
 from collections import namedtuple
 from typing import Dict, List, Optional, Tuple
 
-from rnb_tpu.config import PipelineConfig
+from rnb_tpu.config import (  # DEFAULT_... re-exported for back-compat
+    DEFAULT_NUM_SHARED_TENSORS, ConfigError, PipelineConfig)
 from rnb_tpu.devices import DeviceSpec
 from rnb_tpu.utils.class_utils import load_class
-
-#: default ring depth per producer instance (reference control.py:8)
-DEFAULT_NUM_SHARED_TENSORS = 10
 
 #: sentinel count marking end-of-stream on every edge (reference
 #: client.py:9, runner.py:3)
@@ -316,15 +314,24 @@ class ChannelFabric:
 
             step_rings: List[List[Optional[BufferRing]]] = []
             model_class = load_class(step.model) if not is_final else None
-            num_slots = (step.num_shared_tensors
-                         if step.num_shared_tensors is not None
-                         else DEFAULT_NUM_SHARED_TENSORS)
+            num_slots = step.effective_shared_tensors
             for group_idx, group in enumerate(step.groups):
                 shapes = None
                 if model_class is not None:
                     shapes = model_class.output_shape_for(
                         **step.kwargs_for_group(group_idx))
                     if shapes is not None:
+                        # authoritative deadlock guard (parse_config
+                        # repeats it conservatively for configs that
+                        # never reach fabric construction): a producer
+                        # fills one slot per segment before publishing
+                        # any Signal, so slots < segments hangs forever
+                        if num_slots < step.num_segments:
+                            raise ConfigError(
+                                "step %d: ring of %d slots cannot hold "
+                                "%d segments — the producer would "
+                                "deadlock" % (step_idx, num_slots,
+                                              step.num_segments))
                         shapes = get_segmented_shapes(
                             tuple(map(tuple, shapes)), step.num_segments)
                 group_rings: List[Optional[BufferRing]] = []

@@ -111,3 +111,50 @@ def check_devices(specs: List[DeviceSpec]) -> None:
     """Resolve every spec, raising DeviceResolutionError for bad ones."""
     for spec in specs:
         spec.resolve()
+
+
+#: bytes_in_use above this before we allocate anything suggests another
+#: client holds buffers on the chip (the TPU runtime itself keeps a few
+#: hundred KiB resident, so 0 is never the idle reading)
+BUSY_BYTES_THRESHOLD = 16 * 1024 * 1024
+
+
+def probe_busy_devices(specs: List[DeviceSpec]) -> List[str]:
+    """Best-effort "device already in use" warning list.
+
+    The reference refused to start unless every requested GPU reported
+    zero bytes of used memory (reference benchmark.py:97-125). A TPU
+    runtime owns the whole slice so exact parity is impossible, but
+    ``Device.memory_stats()`` — where the backend implements it —
+    exposes ``bytes_in_use`` before this job allocates anything; a
+    non-trivial figure means some other client has live buffers on the
+    chip (e.g. a concurrent tunnel session). Unlike the reference this
+    returns warnings instead of aborting: shared-chip contention
+    degrades throughput but does not make the run incorrect.
+    """
+    warnings: List[str] = []
+    seen = set()
+    for spec in specs:
+        if spec.is_host:
+            continue
+        try:
+            device = spec.resolve()
+        except DeviceResolutionError:
+            continue  # best-effort: resolution errors are check_devices' job
+        if device in seen:
+            continue
+        seen.add(device)
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            continue  # backend without memory introspection
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use", 0)
+        if in_use > BUSY_BYTES_THRESHOLD:
+            warnings.append(
+                "device %s already has %.1f MiB in use before this job "
+                "allocated anything — another process may be sharing the "
+                "chip; expect degraded and noisy throughput"
+                % (spec.label, in_use / (1024.0 * 1024.0)))
+    return warnings

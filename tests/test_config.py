@@ -99,6 +99,23 @@ def test_num_segments_validation():
         parse_config(_minimal(step0={"num_segments": "3"}))
 
 
+def test_segments_exceeding_ring_slots_rejected():
+    # the producer fills one ring slot per segment before publishing any
+    # Signal, so slots < segments would self-deadlock at runtime — this
+    # must fail fast at parse time instead
+    with pytest.raises(ConfigError, match="deadlock"):
+        parse_config(_minimal(step0={"num_segments": 3,
+                                     "num_shared_tensors": 2}))
+    # default ring depth is 10: 11 segments must also be rejected even
+    # when 'num_shared_tensors' is omitted
+    with pytest.raises(ConfigError, match="the default"):
+        parse_config(_minimal(step0={"num_segments": 11}))
+    # boundary: exactly as many slots as segments is legal
+    pc = parse_config(_minimal(step0={"num_segments": 3,
+                                      "num_shared_tensors": 3}))
+    assert pc.steps[0].num_segments == 3
+
+
 def test_all_shipped_configs_parse_and_resolve():
     for path in sorted(glob.glob(os.path.join(REPO_ROOT, "configs",
                                               "*.json"))):
@@ -121,6 +138,40 @@ def test_device_spec_resolution():
         DeviceSpec("nope:0").resolve()
     with pytest.raises(DeviceResolutionError):
         DeviceSpec(2.5).resolve()
+
+
+def test_probe_busy_devices():
+    from rnb_tpu.devices import BUSY_BYTES_THRESHOLD, probe_busy_devices
+
+    class FakeSpec:
+        is_host = False
+
+        def __init__(self, stats, label="tpu:0"):
+            self._stats = stats
+            self.label = label
+
+        def resolve(self):
+            return self
+
+        def memory_stats(self):
+            if isinstance(self._stats, Exception):
+                raise self._stats
+            return self._stats
+
+    busy = FakeSpec({"bytes_in_use": BUSY_BYTES_THRESHOLD + 1})
+    idle = FakeSpec({"bytes_in_use": 512 * 1024}, label="tpu:1")
+    opaque = FakeSpec(None, label="tpu:2")
+    broken = FakeSpec(RuntimeError("no stats"), label="tpu:3")
+    host = FakeSpec({"bytes_in_use": 10 ** 12}, label="host")
+    host.is_host = True
+
+    warnings = probe_busy_devices([busy, idle, opaque, broken, host, busy])
+    assert len(warnings) == 1  # busy flagged once despite appearing twice
+    assert "tpu:0" in warnings[0] and "in use" in warnings[0]
+
+    # real backend: must never raise, whatever the platform reports
+    pc = parse_config(_minimal())
+    assert isinstance(probe_busy_devices(pc.all_devices()), list)
 
 
 def test_check_devices_over_config():
