@@ -36,6 +36,14 @@ RESERVED_KEYWORDS = [
 #: can check the effective slot count at parse time.
 DEFAULT_NUM_SHARED_TENSORS = 10
 
+
+def _effective_shared_tensors(num_shared_tensors: Optional[int]) -> int:
+    """The one defaulting rule for ring depth — used by parse-time
+    validation and by StepConfig.effective_shared_tensors (which
+    ChannelFabric allocation reads)."""
+    return (num_shared_tensors if num_shared_tensors is not None
+            else DEFAULT_NUM_SHARED_TENSORS)
+
 DEFAULT_QUEUE_SELECTOR = "rnb_tpu.selector.RoundRobinSelector"
 
 
@@ -79,11 +87,8 @@ class StepConfig:
 
     @property
     def effective_shared_tensors(self) -> int:
-        """Ring slots per producer instance after defaulting — the single
-        definition both validation and ChannelFabric allocation use."""
-        return (self.num_shared_tensors
-                if self.num_shared_tensors is not None
-                else DEFAULT_NUM_SHARED_TENSORS)
+        """Ring slots per producer instance after defaulting."""
+        return _effective_shared_tensors(self.num_shared_tensors)
 
     def kwargs_for_group(self, group_idx: int) -> Dict[str, Any]:
         """Model-constructor kwargs: step extras overridden by group extras
@@ -181,8 +186,7 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
         # validation must not do) cannot deadlock, but is still rejected
         # here; declare num_shared_tensors >= num_segments to get past
         # (harmless when no ring is allocated).
-        effective_slots = (num_shared_tensors if num_shared_tensors is not None
-                           else DEFAULT_NUM_SHARED_TENSORS)
+        effective_slots = _effective_shared_tensors(num_shared_tensors)
         _expect(num_segments <= effective_slots,
                 "%s: 'num_segments' (%d) exceeds the shared-tensor ring "
                 "size (%d%s) — the producer would deadlock waiting on a "

@@ -26,11 +26,15 @@ do not bound it), its tick rate is not host nanoseconds (observed
 is uniform) — so window-scoped busy fractions are not recoverable
 there and the full-span fraction under-reports steady-state
 utilization. Per-op accumulated durations remain valid relative
-measures (same tick scale); dividing total busy by the observed tick
-ratio reproduced the analytic MFU within noise (8.2 s busy / 4.3 over
-a 4.1 s window ~ 46% vs ~34% MFU + copies). On backends whose traces
-honor capture bounds, the marker window (preferred) or the epoch
-header (fallback) scopes the report to the measured window.
+measures (same tick scale); dividing total busy by the tick ratio
+reproduced the analytic MFU within noise (8.2 s busy / ~4.3 over a
+4.1 s window ~ 46% vs ~34% MFU + copies). The tick ratio is now
+derived automatically from the window markers' device-clock
+separation vs the host window duration (:func:`marker_tick_ratio`),
+and on inverted traces the rescaled session-busy estimate is printed
+in place of the (unrecoverable) window fraction. On backends whose
+traces honor capture bounds, the marker window (preferred) or the
+epoch header (fallback) scopes the report to the measured window.
 """
 
 from __future__ import annotations
@@ -53,25 +57,45 @@ def is_device_op(name: str) -> bool:
                 or name.startswith("Thread "))
 
 
+def _sniff_four_col(line: str) -> bool:
+    """Does a header-less data row look like the 4-column format?
+
+    4+ whitespace-separated fields, two leading integers, and a plane
+    token (``/device:`` or ``/host:``) third — without this sniff a
+    4-column file whose header was stripped would silently fold the
+    plane token into the op name under ``"(all)"``.
+    """
+    parts = line.split()
+    if len(parts) < 4:
+        return False
+    try:
+        int(parts[0]), int(parts[1])
+    except ValueError:
+        return False
+    return DEVICE_PLANE_MARKER in parts[2] or "/host:" in parts[2]
+
+
 def load_intervals(path: str, device_only: bool = True):
     """-> {plane: [(t0_ns, t1_ns, name)]} from an xprof-ops.txt file.
 
     Two formats: the current 4-column ``t0 t1 plane name`` (marked by
-    a ``# t0_ns t1_ns plane op_name`` header) and the legacy 3-column
+    a ``# t0_ns t1_ns plane op_name`` header, or sniffed from the first
+    data row when the header is missing) and the legacy 3-column
     ``t0 t1 name``, which lands under the single plane ``"(all)"``.
     Per-plane grouping matters: XLine clock bases differ across planes,
     so a busy-time union across planes conflates clocks (observed as a
     54 s "span" for a 6 s capture before the format carried the plane).
     """
     out = {}
+    four_col = None  # decided by the header, else sniffed from data
     with open(path) as f:
-        first = f.readline()
-        four_col = first.startswith("#") and "plane" in first
-        if not first.startswith("#"):
-            f.seek(0)
         for line in f:
             if line.startswith("#"):
+                if four_col is None and "plane" in line.split():
+                    four_col = True  # the '# t0_ns t1_ns plane op_name' header
                 continue
+            if four_col is None:
+                four_col = _sniff_four_col(line)
             if four_col:
                 parts = line.rstrip("\n").split(" ", 3)
                 if len(parts) != 4:
@@ -115,8 +139,14 @@ def load_window(path: str):
 MARKER = "rnb_window_marker"
 
 
+def marker_events(intervals):
+    """Sorted [(t0, t1)] of the window-marker ops in one plane."""
+    return sorted((t0, t1) for t0, t1, n in intervals if MARKER in n)
+
+
 def marker_window(intervals):
-    """-> (w0_ns, w1_ns) from the window-marker ops, or None.
+    """-> (w0_ns, w1_ns) from the window-marker ops, ``"inverted"``
+    when markers exist but are non-chronological, or None when absent.
 
     ``rnb_tpu.benchmark --xprof`` dispatches a jitted no-op named
     ``rnb_window_marker`` right before releasing the start barrier and
@@ -124,17 +154,44 @@ def marker_window(intervals):
     own clock, so the window needs no host-epoch mapping (the remote
     xplane timeline is session-scoped and its tick rate is not
     host-ns). Window = end of the first marker to start of the last;
-    needs at least two marker events.
+    needs at least two marker events. ``"inverted"`` is the documented
+    remote/axon failure mode (timestamps not session-chronological):
+    the markers cannot delimit anything, and neither can host epochs —
+    callers must NOT fall back to the epoch mapping in that case.
     """
-    marks = sorted((t0, t1) for t0, t1, n in intervals if MARKER in n)
+    marks = marker_events(intervals)
     if len(marks) < 2:
         return None
     w0, w1 = marks[0][1], marks[-1][0]
     if w1 <= w0:
-        # non-chronological timestamps (see module docstring): the
-        # markers cannot delimit anything; let the caller fall back
-        return None
+        return "inverted"
     return w0, w1
+
+
+def marker_tick_ratio(intervals, window):
+    """Device ticks per host nanosecond, from the markers' separation.
+
+    The two window markers are dispatched a known wall-time apart (the
+    measured window, carried in the host-epoch header), so the ratio of
+    their device-clock separation to that duration calibrates the
+    trace's tick rate — replacing the hand-derived ~4.3x constant this
+    module's docstring used to quote (the reference's CUPTI timestamps
+    were directly in ns, utils/cupti.cpp:120-130, so it never needed
+    this). Uses the extreme marker endpoints, which survives the
+    inverted-timestamp case. Returns None without >=2 markers or a
+    window header.
+    """
+    marks = marker_events(intervals)
+    if len(marks) < 2 or window is None:
+        return None
+    host_ns = (window[1] - window[0]) * 1e9
+    if host_ns <= 0:
+        return None
+    endpoints = [t for m in marks for t in m]
+    dev_sep = max(endpoints) - min(endpoints)
+    if dev_sep <= 0:
+        return None
+    return dev_sep / host_ns
 
 
 def clip_to_window(intervals, window, anchor_t1_ns: int):
@@ -254,7 +311,37 @@ def main(argv=None) -> int:
         # host-epoch header, valid only where the trace timeline is
         # wall-clock ns anchored at the capture stop.
         mwin = marker_window(everything[plane])
-        if mwin is not None:
+        ratio = marker_tick_ratio(everything[plane], window)
+        if ratio is not None:
+            print("tick ratio          : %.4g device ticks per host ns "
+                  "(marker-derived)" % ratio)
+        if mwin == "inverted":
+            # The documented remote/axon case: timestamps are not
+            # session-chronological, so neither the markers nor the
+            # host-epoch mapping can delimit the measured window —
+            # printing the epoch fallback here would put a 'measured
+            # window' number on exactly the traces where it is
+            # meaningless. The marker-derived tick ratio still holds
+            # (it uses only the endpoints' extent), so rescaled
+            # session-total busy vs the host window is the one honest
+            # estimate left — labelled as such, warmup included.
+            print("measured window     : markers are non-chronological "
+                  "(remote session-scoped trace); window busy fraction "
+                  "unrecoverable")
+            if ratio is not None:
+                # marker intervals themselves are trace artifacts, not
+                # device work — with an inverted marker spanning the
+                # extremes they would dominate the union
+                rows = [iv for iv in kept[plane] if MARKER not in iv[2]]
+                est_busy_host_s = merged_busy_ns(rows) / 1e9 / ratio
+                host_window_s = window[1] - window[0]
+                print("session-busy est.   : %.3f s rescaled by tick "
+                      "ratio over the %.3f s host window = %.1f%% "
+                      "(UPPER BOUND: includes pre-window session "
+                      "activity)"
+                      % (est_busy_host_s, host_window_s,
+                         100.0 * est_busy_host_s / host_window_s))
+        elif mwin is not None:
             rows = [iv for iv in kept[plane] if MARKER not in iv[2]]
             clipped = [(max(t0, mwin[0]), min(t1, mwin[1]), n)
                        for t0, t1, n in rows
@@ -266,6 +353,24 @@ def main(argv=None) -> int:
                       "units)"
                       % (wstats["busy_ms"],
                          100.0 * wstats["busy_fraction"]))
+                # markers hugging the trace extremes is EITHER a
+                # bounds-honoring capture (trace == window: CPU
+                # backend) or the session-scoped remote pathology
+                # (markers displaced to the session's ends). The tick
+                # ratio disambiguates: ~1 tick/ns means the timeline
+                # is wall-ns and the window is real; far from 1 means
+                # the "window" is the whole session, warmup included.
+                span_ns = stats["span_ms"] * 1e6
+                if (span_ns > 0
+                        and (mwin[1] - mwin[0]) / span_ns > 0.98
+                        and ratio is not None
+                        and not 0.5 < ratio < 2.0):
+                    print("                      CAUTION: markers sit "
+                          "at the trace extremes and the tick ratio "
+                          "is far from 1 — this is the session-scoped "
+                          "remote trace; the fraction above covers "
+                          "the whole session (warmup included), not "
+                          "the measured window")
             else:
                 print("measured window     : no device ops between "
                       "the markers")

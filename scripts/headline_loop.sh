@@ -11,7 +11,7 @@ cd "$(dirname "$0")/.." || exit 1
 ATTEMPTS=${1:-20}
 SLEEP_S=${2:-600}
 OUT=$(mktemp /tmp/headline_attempt.XXXXXX.json)
-trap 'rm -f "$OUT"' EXIT
+trap 'rm -f "$OUT" "${OUT%.json}.err"' EXIT
 for i in $(seq 1 "$ATTEMPTS"); do
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   RNB_BENCH_INIT_BUDGET_S=${RNB_BENCH_INIT_BUDGET_S:-300} \
@@ -31,16 +31,26 @@ with open("BENCH_ATTEMPTS.jsonl", "a") as f:
                         "source": "auto-headline-loop",
                         "result": result}) + "\n")
 if (rc == 0 and isinstance(result, dict)
-        and result.get("platform") == "tpu" and result.get("value")):
-    try:
-        best = json.load(open("BENCH_TPU.json")).get("value") or 0
-    except Exception:
-        best = 0
-    if result["value"] > best:
-        with open("BENCH_TPU.json", "w") as f:
-            f.write(json.dumps(result) + "\n")
-        print("headline loop: new best %.1f (was %.1f)"
-              % (result["value"], best), file=sys.stderr)
+        and result.get("platform") == "tpu"
+        and isinstance(result.get("value"), (int, float))
+        and result["value"]):
+    # read-modify-write under an exclusive lock (concurrent capture
+    # loops race here), committed via rename so readers never see a
+    # torn file
+    import fcntl, os
+    with open("BENCH_TPU.json.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            best = json.load(open("BENCH_TPU.json")).get("value") or 0
+        except Exception:
+            best = 0
+        if result["value"] > best:
+            tmp = "BENCH_TPU.json.tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(result) + "\n")
+            os.replace(tmp, "BENCH_TPU.json")
+            print("headline loop: new best %.1f (was %.1f)"
+                  % (result["value"], best), file=sys.stderr)
 EOF
   echo "headline loop: attempt $i rc=$rc; sleeping ${SLEEP_S}s" >&2
   sleep "$SLEEP_S"

@@ -365,3 +365,60 @@ def test_device_busy_marker_window(tmp_path, capsys):
     # window [100, 9000): fusion.pre (100) + fusion.in (2000) busy of
     # 8900 -> 23.6%; fusion.post lies outside and is excluded
     assert "marker-delimited window (23.6%" in out
+    # marker separation (9100 ticks) over the 1 s host window
+    assert "tick ratio" in out
+
+
+def test_device_busy_inverted_markers(tmp_path, capsys):
+    """The documented remote/axon case: marker timestamps are
+    non-chronological, so no window can be delimited — the epoch
+    fallback must NOT print a 'measured window' busy fraction, and the
+    marker-derived tick ratio yields the rescaled session-busy upper
+    bound instead."""
+    import device_busy
+
+    trace = tmp_path / "xprof-ops.txt"
+    # markers overlap (first's end 9000 > last's start 2000) ->
+    # inverted; endpoint extent 8000 ticks over the 2 s host window ->
+    # tick ratio 4e-6; session busy excludes the marker artifacts, so
+    # only fusion.in's 1000 ticks count -> 0.25 s host-rescaled over
+    # the 2.0 s window = 12.5%
+    trace.write_text(
+        "# t0_ns t1_ns plane op_name\n"
+        "# window_epoch 100.0 102.0 flush_epoch 102.0\n"
+        "1000 9000 /device:TPU:0 jit_rnb_window_marker(1)\n"
+        "2000 2100 /device:TPU:0 jit_rnb_window_marker(2)\n"
+        "2000 3000 /device:TPU:0 fusion.in\n")
+    planes = device_busy.load_intervals(str(trace))
+    assert device_busy.marker_window(planes["/device:TPU:0"]) \
+        == "inverted"
+    assert device_busy.marker_tick_ratio(
+        planes["/device:TPU:0"], (100.0, 102.0, 102.0)) \
+        == pytest.approx(4e-6)
+    assert device_busy.main([str(trace)]) == 0
+    out = capsys.readouterr().out
+    assert "unrecoverable" in out
+    assert "of window)" not in out  # epoch fallback suppressed
+    assert "= 12.5%" in out  # marker-free rescaled session-busy estimate
+
+
+def test_device_busy_headerless_four_col_sniffed(tmp_path, capsys):
+    """A 4-column file whose header line was stripped must still be
+    parsed per-plane (sniffed from the first data row), not folded
+    into '(all)' with the plane token glued onto the op name."""
+    import device_busy
+
+    trace = tmp_path / "xprof-ops.txt"
+    trace.write_text("0 100 /device:TPU:0 fusion.1\n"
+                     "50 150 /host:CPU cpu_thing\n")
+    planes = device_busy.load_intervals(str(trace), device_only=False)
+    assert set(planes) == {"/device:TPU:0", "/host:CPU"}
+    assert planes["/device:TPU:0"] == [(0, 100, "fusion.1")]
+    assert device_busy.main([str(trace)]) == 0
+    capsys.readouterr()
+    # a retained window_epoch comment must not defeat the sniff: the
+    # format decision comes from the first DATA row
+    trace.write_text("# window_epoch 100.0 102.0 flush_epoch 102.0\n"
+                     "0 100 /device:TPU:0 fusion.1\n")
+    planes = device_busy.load_intervals(str(trace), device_only=False)
+    assert set(planes) == {"/device:TPU:0"}
