@@ -1,0 +1,49 @@
+"""Regenerate the golden-logits fixture for tests/test_network_oracle.py.
+
+Run deliberately, only when the R(2+1)D architecture changes on
+purpose:
+
+    JAX_PLATFORMS=cpu python scripts/make_golden_logits.py
+
+The fixture pins one seeded float32 full-net forward (params from
+``init(PRNGKey(param_seed))``, input from
+``np.random.default_rng(input_seed)``) so silent numerical drift
+between rounds fails the suite.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PARAM_SEED = 0
+INPUT_SEED = 2026
+INPUT_SHAPE = (2, 8, 112, 112, 3)
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from rnb_tpu.models.r2p1d.network import R2Plus1DClassifier
+
+    rng = np.random.default_rng(INPUT_SEED)
+    x = jnp.asarray(rng.normal(size=INPUT_SHAPE).astype(np.float32))
+    module = R2Plus1DClassifier(dtype=jnp.float32)
+    variables = module.init(jax.random.PRNGKey(PARAM_SEED), x, train=False)
+    logits = np.asarray(module.apply(variables, x, train=False))
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "golden", "r2p1d_logits.npz")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    np.savez(out, logits=logits, param_seed=PARAM_SEED,
+             input_seed=INPUT_SEED, input_shape=np.array(INPUT_SHAPE))
+    print("wrote %s: logits %s, |mean| %.4f, std %.4f"
+          % (out, logits.shape, abs(logits.mean()), logits.std()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Pure-numpy oracle for the R(2+1)D network — no Flax, no XLA.
+
+An independent re-derivation of the factored (2+1)D math from the
+paper's definition (Tran et al., CVPR'18; reference consumes it via
+the R2Plus1D-PyTorch submodule, /root/reference/models/r2p1d/
+network.py:9-60): direct sliding-window 3-D convolution in float64,
+inference-mode batch norm, the factored-channel formula, residual
+blocks, and the layer-range composition. Tests drive the Flax modules
+(rnb_tpu.models.r2p1d.network) and this oracle with the SAME parameter
+arrays and assert the outputs agree — catching padding/stride/
+factorization regressions that Flax-vs-Flax tests cannot (they would
+agree with their own bug).
+
+The only things taken from the Flax side are the parameter *values*
+(plain numpy arrays pulled out of the variables pytree) and the
+architecture hyperparameters; every floating-point operation here is
+numpy on float64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+BN_EPS = 1e-5  # flax.linen.BatchNorm default epsilon
+
+R18_LAYER_SIZES = (2, 2, 2, 2)
+
+
+def conv3d(x, w, strides, padding):
+    """Direct sliding-window 3-D convolution, NDHWC x (kt,kh,kw,ci,co).
+
+    ``padding`` is ((pt0,pt1),(ph0,ph1),(pw0,pw1)). Accumulates one
+    kernel tap at a time over the strided input view — deliberately
+    the textbook formulation, not an im2col/FFT restatement of what a
+    conv library would do.
+    """
+    x = np.asarray(x, np.float64)
+    w = np.asarray(w, np.float64)
+    x = np.pad(x, ((0, 0),) + tuple(padding) + ((0, 0),))
+    st, sh, sw = strides
+    kt, kh, kw, cin, cout = w.shape
+    n, t, h, wd, c = x.shape
+    assert c == cin, (c, cin)
+    ot = (t - kt) // st + 1
+    oh = (h - kh) // sh + 1
+    ow = (wd - kw) // sw + 1
+    out = np.zeros((n, ot, oh, ow, cout), np.float64)
+    for a in range(kt):
+        for b in range(kh):
+            for d in range(kw):
+                view = x[:, a:a + st * ot:st, b:b + sh * oh:sh,
+                         d:d + sw * ow:sw, :]
+                out += np.einsum("nthwc,co->nthwo", view, w[a, b, d])
+    return out
+
+
+def batchnorm(x, scale, bias, mean, var):
+    """Inference-mode batch norm over the channel axis."""
+    x = np.asarray(x, np.float64)
+    return ((x - mean) / np.sqrt(np.asarray(var, np.float64) + BN_EPS)
+            * scale + bias)
+
+
+def relu(x):
+    return np.maximum(x, 0.0)
+
+
+def _bn_args(params, stats):
+    return (params["scale"], params["bias"], stats["mean"], stats["var"])
+
+
+def spatiotemporal_conv(var, x, kernel, stride=(1, 1)):
+    """The factored conv: spatial (1,d,d) conv, BN, ReLU, temporal
+    (t,1,1) conv. ``var`` is the module's {"params", "batch_stats"}
+    subtree."""
+    t, d = kernel
+    st, sd = stride
+    p, s = var["params"], var.get("batch_stats", {})
+    x = conv3d(x, p["spatial"]["kernel"], (1, sd, sd),
+               ((0, 0), (d // 2, d // 2), (d // 2, d // 2)))
+    x = batchnorm(x, *_bn_args(p["bn"], s["bn"]))
+    x = relu(x)
+    x = conv3d(x, p["temporal"]["kernel"], (st, 1, 1),
+               ((t // 2, t // 2), (0, 0), (0, 0)))
+    return x
+
+
+def _sub(var, name):
+    return {"params": var["params"][name],
+            "batch_stats": var.get("batch_stats", {}).get(name, {})}
+
+
+def res_block(var, x, downsample=False, factored_shortcut=False):
+    stride = 2 if downsample else 1
+    p, s = var["params"], var.get("batch_stats", {})
+    res = spatiotemporal_conv(_sub(var, "conv1"), x, (3, 3),
+                              (stride, stride))
+    res = batchnorm(res, *_bn_args(p["bn1"], s["bn1"]))
+    res = relu(res)
+    res = spatiotemporal_conv(_sub(var, "conv2"), res, (3, 3))
+    res = batchnorm(res, *_bn_args(p["bn2"], s["bn2"]))
+    if downsample:
+        if factored_shortcut:
+            x = spatiotemporal_conv(_sub(var, "shortcut"), x, (1, 1),
+                                    (2, 2))
+        else:
+            x = conv3d(x, p["shortcut"]["kernel"], (2, 2, 2),
+                       ((0, 0), (0, 0), (0, 0)))
+        x = batchnorm(x, *_bn_args(p["shortcut_bn"], s["shortcut_bn"]))
+    return relu(x + res)
+
+
+def res_layer(var, x, num_blocks, downsample=False,
+              factored_shortcut=False):
+    x = res_block(_sub(var, "block0"), x, downsample=downsample,
+                  factored_shortcut=factored_shortcut)
+    for i in range(1, num_blocks):
+        x = res_block(_sub(var, "block%d" % i), x)
+    return x
+
+
+def r2plus1d_net(var, x, start=1, end=5, layer_sizes=R18_LAYER_SIZES,
+                 factored_shortcut=False):
+    """The layer-range network: stem (+BN+ReLU) when layer 1 is in
+    range, residual stages 2..5, global spatiotemporal mean pool when
+    the range reaches layer 5."""
+    p, s = var["params"], var.get("batch_stats", {})
+    for layer in range(start, end + 1):
+        if layer == 1:
+            x = spatiotemporal_conv(_sub(var, "conv1"), x, (3, 7), (1, 2))
+            x = batchnorm(x, *_bn_args(p["stem_bn"], s["stem_bn"]))
+            x = relu(x)
+        else:
+            x = res_layer(_sub(var, "conv%d" % layer), x,
+                          num_blocks=layer_sizes[layer - 2],
+                          downsample=(layer >= 3),
+                          factored_shortcut=factored_shortcut)
+    if end == 5:
+        x = x.mean(axis=(1, 2, 3))
+    return x
+
+
+def r2plus1d_classifier(var, x, start=1, end=5,
+                        layer_sizes=R18_LAYER_SIZES,
+                        factored_shortcut=False):
+    x = r2plus1d_net(_sub(var, "net"), x, start=start, end=end,
+                     layer_sizes=layer_sizes,
+                     factored_shortcut=factored_shortcut)
+    if end == 5:
+        p = var["params"]["linear"]
+        x = x @ np.asarray(p["kernel"], np.float64) \
+            + np.asarray(p["bias"], np.float64)
+    return x
