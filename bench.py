@@ -27,10 +27,12 @@ the moment the pipeline idles).
 decode through NVVL (reference models/r2p1d/model.py:140-151), so this
 bench decodes real files too: it generates (once, cached under
 ``data/bench_y4m``) a y4m dataset via scripts/make_dataset.py and runs
-it through the native C++ decode pool. ``RNB_BENCH_DATASET=synth``
-restores the synthetic-id mode for apples-to-apples comparison with
-rounds ≤3; the emitted ``decode_backend`` key states which path was
-measured.
+it through the native C++ decode pool. ``RNB_BENCH_DATASET=mjpeg``
+switches to compressed MJPEG input (baseline-JPEG Huffman+IDCT per
+frame in native/decode.cpp — real codec work, the role NVDEC filled
+for the reference); ``RNB_BENCH_DATASET=synth`` restores the
+synthetic-id mode for apples-to-apples comparison with rounds ≤3; the
+emitted ``decode_backend`` key states which path was measured.
 
 Prints exactly ONE JSON line with throughput plus the evidence keys the
 perf claim needs to be auditable:
@@ -61,7 +63,7 @@ budget.
 Env knobs: RNB_BENCH_VIDEOS (default 10000: a >10s measured window at
 the round-4 fused flagship's ~900 videos/s on
 TPU), RNB_BENCH_CONFIG, RNB_BENCH_MEAN_INTERVAL_MS (default 0 = bulk),
-RNB_BENCH_DATASET (y4m|synth, default y4m), RNB_TPU_DATA_ROOT (use an
+RNB_BENCH_DATASET (y4m|mjpeg|synth, default y4m), RNB_TPU_DATA_ROOT (use an
 existing dataset instead of generating), RNB_BENCH_PLATFORM (e.g.
 "cpu" to force the CPU backend for smoke runs; skips the probe),
 RNB_BENCH_INIT_BUDGET_S (default 600) total probe budget,
@@ -196,9 +198,9 @@ def _dataset_spec():
             "--colorspace", e("RNB_BENCH_DATASET_COLORSPACE", "420"))
 
 
-def _count_y4m(root: str) -> int:
+def _count_videos(root: str, exts=(".y4m",)) -> int:
     """Count videos using EXACTLY the pipeline iterator's scan rule
-    (root/<label>/*.y4m, one level — R2P1DVideoPathIterator): a dataset
+    (root/<label>/*<ext>, one level — R2P1DVideoPathIterator): a dataset
     this count accepts is a dataset the measured run actually consumes,
     so decode_backend can never claim real decode over a layout the
     iterator would silently skip (falling back to synth:// ids)."""
@@ -209,7 +211,7 @@ def _count_y4m(root: str) -> int:
         label_dir = os.path.join(root, label)
         if os.path.isdir(label_dir):
             total += sum(1 for v in os.listdir(label_dir)
-                         if v.endswith(".y4m"))
+                         if v.endswith(tuple(exts)))
     return total
 
 
@@ -226,15 +228,22 @@ def _ensure_dataset(repo_dir: str):
     if mode == "synth":
         os.environ.pop("RNB_TPU_DATA_ROOT", None)
         return "synthetic", None
-    if mode != "y4m":
-        raise ValueError("RNB_BENCH_DATASET must be y4m or synth, got %r"
-                         % mode)
+    if mode not in ("y4m", "mjpeg"):
+        raise ValueError("RNB_BENCH_DATASET must be y4m, mjpeg or "
+                         "synth, got %r" % mode)
+    exts = (".y4m",) if mode == "y4m" else (".mjpg", ".mjpeg")
     user_root = os.environ.get("RNB_TPU_DATA_ROOT")
-    root = user_root or os.path.join(repo_dir, "data", "bench_y4m")
+    root = user_root or os.path.join(repo_dir, "data", "bench_" + mode)
     spec = list(_dataset_spec())
+    if mode == "mjpeg":
+        # real codec work per frame: baseline-JPEG entropy decode +
+        # IDCT (native/decode.cpp), the role NVDEC filled for the
+        # reference (README.md:42-110)
+        spec += ["--format", "mjpeg", "--quality",
+                 os.environ.get("RNB_BENCH_MJPEG_QUALITY", "90")]
     spec_path = os.path.join(root, "DATASET_SPEC.json")
     spec_stale = False
-    if not user_root and _count_y4m(root) > 0:
+    if not user_root and _count_videos(root, exts) > 0:
         # the generated cache is keyed by its spec: a geometry change
         # (e.g. the round-4 clip-mix fix) must regenerate, or the run
         # silently measures the old population while the evidence
@@ -244,30 +253,45 @@ def _ensure_dataset(repo_dir: str):
                 spec_stale = json.load(f) != spec
         except (OSError, ValueError):
             spec_stale = True
-    if _count_y4m(root) == 0 or spec_stale:
+    if _count_videos(root, exts) == 0 or spec_stale:
         if spec_stale:
             import shutil
             sys.stderr.write("bench: regenerating %s (spec changed)\n"
                              % root)
             shutil.rmtree(root, ignore_errors=True)
         else:
-            sys.stderr.write("bench: generating y4m dataset under %s\n"
-                             % root)
+            sys.stderr.write("bench: generating %s dataset under %s\n"
+                             % (mode, root))
         subprocess.run(
             [sys.executable,
              os.path.join(repo_dir, "scripts", "make_dataset.py"),
              "--root", root, *spec],
             check=True, stdout=subprocess.DEVNULL)
-        if _count_y4m(root) == 0:
+        if _count_videos(root, exts) == 0:
             raise RuntimeError(
-                "dataset generation produced no root/label/*.y4m videos "
+                "dataset generation produced no root/label/* videos "
                 "under %s" % root)
         if not user_root:
             with open(spec_path, "w") as f:
                 json.dump(spec, f)
+    # the iterator consumes EVERY supported extension, so a root mixing
+    # formats would measure a different population than decode_backend
+    # claims — fail loud instead of publishing false evidence
+    other_exts = (".mjpg", ".mjpeg") if mode == "y4m" else (".y4m",)
+    n_other = _count_videos(root, other_exts)
+    if n_other:
+        raise RuntimeError(
+            "dataset root %s holds %d %s video(s) alongside the %s "
+            "dataset — the pipeline iterator would consume both and "
+            "the decode_backend evidence key would lie; use a "
+            "single-format root" % (root, n_other, other_exts, mode))
     os.environ["RNB_TPU_DATA_ROOT"] = root
     from rnb_tpu.decode.native import native_available
-    backend = "native-y4m" if native_available() else "numpy-y4m"
+    native = native_available()
+    if mode == "mjpeg":
+        backend = "native-mjpeg" if native else "pil-mjpeg"
+    else:
+        backend = "native-y4m" if native else "numpy-y4m"
     return backend, root
 
 

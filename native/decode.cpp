@@ -4,9 +4,18 @@
 // is host CPU work; this library makes it native C++ with a worker
 // pool so the decode stage keeps up with the accelerator.
 //
-// Format: uncompressed YUV4MPEG2 (.y4m), 4:2:0 or 4:4:4 — the format
-// the pure-numpy Y4MDecoder (rnb_tpu/decode/__init__.py) also speaks;
-// the two backends are numerically parity-tested against each other.
+// Formats:
+//  * Uncompressed YUV4MPEG2 (.y4m), 4:2:0 or 4:4:4 — the format the
+//    pure-numpy Y4MDecoder (rnb_tpu/decode/__init__.py) also speaks;
+//    the two backends are numerically parity-tested against each
+//    other.
+//  * MJPEG (.mjpg): concatenated baseline JPEG frames, decoded by the
+//    self-contained baseline decoder below (Huffman + dequant + IDCT,
+//    4:2:0 or 4:4:4) — REAL codec compute in the measured loop, the
+//    role NVDEC played for the reference (README.md:42-110). Parity
+//    oracle: PIL/libjpeg in tests/test_mjpeg.py.
+// The container is sniffed from the magic bytes; every entry point
+// accepts either.
 //
 // Design notes:
 //  * The decode of one output pixel needs exactly one Y/U/V sample
@@ -19,7 +28,10 @@
 //  * The pool is a plain mutex+condvar job queue; one ticket per
 //    submitted decode, waitable from any thread.
 
+#include <sys/stat.h>
+
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -129,6 +141,581 @@ inline unsigned char ClipByte(float v) {
   if (v > 255.f) v = 255.f;
   return static_cast<unsigned char>(v);  // trunc, matches np.astype(u8)
 }
+
+// ---------------------------------------------------------------------------
+// Baseline JPEG decoder (ITU T.81 sequential DCT, 8-bit, Huffman).
+// Self-contained: no libjpeg in this image. Decodes one frame into
+// planar YCbCr at the source geometry (the same payload layout the y4m
+// path reads), so the fused convert/gather stages are shared between
+// containers. Supports 3-component 4:2:0 (2x2,1x1,1x1) and 4:4:4
+// (1x1 x3) sampling, restart markers, multiple DQT/DHT segments.
+
+constexpr int kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+struct HuffTable {
+  // canonical decode per ITU T.81 F.2.2.3, plus an 8-bit lookahead
+  // table (libjpeg's technique): one Peek(8) resolves the vast
+  // majority of symbols without the per-bit walk.
+  int mincode[17] = {0};
+  int maxcode[17] = {0};  // -1 where no codes of that length
+  int valptr[17] = {0};
+  unsigned char values[256] = {0};
+  unsigned short lut[256] = {0};  // (len << 8) | symbol; 0 = miss
+  bool present = false;
+
+  void Build(const unsigned char counts[16], const unsigned char* vals,
+             int nvals) {
+    for (int i = 0; i < nvals && i < 256; ++i) values[i] = vals[i];
+    int code = 0, k = 0;
+    std::memset(lut, 0, sizeof(lut));
+    for (int l = 1; l <= 16; ++l) {
+      valptr[l] = k;
+      mincode[l] = code;
+      const int n = counts[l - 1];
+      if (l <= 8) {
+        for (int i = 0; i < n; ++i) {
+          const int c = code + i;
+          const int base = c << (8 - l);
+          for (int fill = 0; fill < (1 << (8 - l)); ++fill)
+            lut[base | fill] =
+                static_cast<unsigned short>((l << 8) | values[k + i]);
+        }
+      }
+      code += n;
+      k += n;
+      maxcode[l] = n ? code - 1 : -1;
+      code <<= 1;
+    }
+    present = true;
+  }
+};
+
+struct BitReader {
+  const unsigned char* d;
+  size_t n, pos;
+  unsigned long long acc = 0;  // MSB-justified within `count` bits
+  int count = 0;
+  bool starved = false;  // zero bits were synthesized past a marker/EOF
+
+  BitReader(const unsigned char* data, size_t len)
+      : d(data), n(len), pos(0) {}
+
+  void Fill() {
+    while (count <= 56) {
+      unsigned char b;
+      if (pos >= n) {
+        starved = true;
+        b = 0;  // zero-pad: the trailing EOB bits of the last MCU may
+                // legitimately read a few bits past the data end
+      } else {
+        b = d[pos];
+        if (b == 0xFF) {
+          if (pos + 1 < n && d[pos + 1] == 0x00) {
+            pos += 2;  // stuffed zero
+          } else {
+            starved = true;  // a real marker: stop consuming bytes
+            b = 0;
+          }
+        } else {
+          ++pos;
+        }
+      }
+      acc = (acc << 8) | b;
+      count += 8;
+    }
+  }
+
+  inline int Peek(int nbits) {
+    if (count < nbits) Fill();
+    return static_cast<int>((acc >> (count - nbits)) &
+                            ((1ull << nbits) - 1));
+  }
+
+  inline void Drop(int nbits) { count -= nbits; }
+
+  inline int GetBits(int nbits) {
+    if (nbits == 0) return 0;
+    const int v = Peek(nbits);
+    count -= nbits;
+    return v;
+  }
+
+  // byte-align and consume an expected RSTn marker (0xD0..0xD7)
+  bool ConsumeRestart() {
+    count = 0;
+    acc = 0;
+    starved = false;
+    if (pos + 1 >= n || d[pos] != 0xFF) return false;
+    const unsigned char m = d[pos + 1];
+    if (m < 0xD0 || m > 0xD7) return false;
+    pos += 2;
+    return true;
+  }
+};
+
+inline int HuffDecode(BitReader* br, const HuffTable& t) {
+  const unsigned short hit = t.lut[br->Peek(8)];
+  if (hit) {
+    br->Drop(hit >> 8);
+    return hit & 0xFF;
+  }
+  // slow path: codes longer than 8 bits (rare with standard tables)
+  int code = br->Peek(8);
+  int consumed = 8;
+  for (int l = 9; l <= 16; ++l) {
+    code = (code << 1) | ((br->Peek(l) & 1));
+    consumed = l;
+    if (t.maxcode[l] >= 0 && code <= t.maxcode[l]) {
+      br->Drop(consumed);
+      return t.values[t.valptr[l] + code - t.mincode[l]];
+    }
+  }
+  return -1;  // invalid code
+}
+
+inline int Extend(int v, int s) {
+  return (s && v < (1 << (s - 1))) ? v - (1 << s) + 1 : v;
+}
+
+// k[u][x] = 0.5 * alpha(u) * cos((2x+1) u pi / 16); DC-only block
+// collapses to F00/8.
+struct IdctTable {
+  float k[8][8];
+  IdctTable() {
+    for (int u = 0; u < 8; ++u)
+      for (int x = 0; x < 8; ++x)
+        k[u][x] = 0.5f * (u == 0 ? 0.70710678f : 1.0f) *
+                  std::cos(float((2 * x + 1)) * u * 3.14159265358979f / 16.0f);
+  }
+};
+
+// row_mask: bit v set when coefficient row v has any nonzero entry —
+// zero rows contribute nothing to either pass, and most blocks at
+// typical qualities populate only the first few rows. Inner loops are
+// fixed 8-wide with no branches so the compiler can vectorize them;
+// FMA contraction is re-enabled here (the file-level -ffp-contract=off
+// exists for the y4m RGB conversion's bit-exact numpy parity, which
+// the IDCT does not participate in).
+#pragma GCC push_options
+#pragma GCC optimize("fp-contract=fast")
+void Idct8x8(const float* blk, int row_mask, unsigned char* out,
+             int out_stride) {
+  static const IdctTable tab;
+  float tmp[64];  // tmp[v][x] = sum_u k[u][x] * blk[v*8+u]
+  float accum[64] = {0.f};  // accum[y][x]
+  for (int v = 0; v < 8; ++v) {
+    if (!(row_mask & (1 << v))) continue;
+    const float* row = blk + v * 8;
+    float* trow = tmp + v * 8;
+    for (int x = 0; x < 8; ++x) {
+      float s = 0.f;
+      for (int u = 0; u < 8; ++u) s += tab.k[u][x] * row[u];
+      trow[x] = s;
+    }
+    for (int y = 0; y < 8; ++y) {
+      const float kv = tab.k[v][y];
+      float* arow = accum + y * 8;
+      for (int x = 0; x < 8; ++x) arow[x] += kv * trow[x];
+    }
+  }
+  for (int y = 0; y < 8; ++y) {
+    unsigned char* orow = out + y * out_stride;
+    const float* arow = accum + y * 8;
+    for (int x = 0; x < 8; ++x) {
+      const float px = arow[x] + 128.0f;
+      orow[x] = ClipByte(px < 0.f ? 0.f : (px + 0.5f));  // round half up
+    }
+  }
+}
+#pragma GCC pop_options
+
+struct JpegComponent {
+  int id = 0, h = 1, v = 1, tq = 0, td = 0, ta = 0;
+  int plane_w = 0, plane_h = 0;  // MCU-padded
+  std::vector<unsigned char> plane;
+};
+
+// Decode one baseline JPEG into planar samples at source geometry.
+// On success fills width/height/subsample and the payload vector in
+// y4m plane order (Y, then Cb, Cr at w/sub x h/sub).
+int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
+                    int* height, int* subsample,
+                    std::vector<unsigned char>* payload) {
+  if (n < 4 || data[0] != 0xFF || data[1] != 0xD8) return kErrFormat;
+  unsigned short qt[4][64];
+  bool qt_ok[4] = {false, false, false, false};
+  HuffTable hdc[4], hac[4];
+  JpegComponent comps[3];
+  int ncomp = 0, w = 0, h = 0, restart_interval = 0;
+  size_t p = 2;
+  bool sos = false;
+  size_t scan_start = 0;
+  while (!sos) {
+    // find the next marker (skip fill bytes)
+    while (p < n && data[p] != 0xFF) ++p;
+    while (p < n && data[p] == 0xFF) ++p;
+    if (p >= n) return kErrFormat;
+    const unsigned char m = data[p];
+    ++p;
+    if (m == 0xD9) return kErrFormat;  // EOI before SOS
+    if (m >= 0xD0 && m <= 0xD7) continue;  // stray RST
+    if (p + 2 > n) return kErrFormat;
+    const size_t seg_len = (data[p] << 8) | data[p + 1];
+    if (seg_len < 2 || p + seg_len > n) return kErrFormat;
+    const unsigned char* seg = data + p + 2;
+    const size_t seg_n = seg_len - 2;
+    switch (m) {
+      case 0xDB: {  // DQT: one or more tables
+        size_t q = 0;
+        while (q < seg_n) {
+          const int pq = seg[q] >> 4, tq_id = seg[q] & 15;
+          ++q;
+          if (tq_id > 3) return kErrFormat;
+          const size_t need = pq ? 128 : 64;
+          if (q + need > seg_n) return kErrFormat;
+          for (int k = 0; k < 64; ++k)
+            qt[tq_id][k] = pq ? ((seg[q + 2 * k] << 8) | seg[q + 2 * k + 1])
+                              : seg[q + k];
+          qt_ok[tq_id] = true;
+          q += need;
+        }
+        break;
+      }
+      case 0xC4: {  // DHT: one or more tables
+        size_t q = 0;
+        while (q + 17 <= seg_n) {
+          const int tc = seg[q] >> 4, th = seg[q] & 15;
+          if (th > 3 || tc > 1) return kErrFormat;
+          int nvals = 0;
+          for (int i = 0; i < 16; ++i) nvals += seg[q + 1 + i];
+          if (q + 17 + nvals > seg_n || nvals > 256) return kErrFormat;
+          (tc ? hac[th] : hdc[th]).Build(seg + q + 1, seg + q + 17,
+                                         nvals);
+          q += 17 + nvals;
+        }
+        break;
+      }
+      case 0xC0:
+      case 0xC1: {  // baseline / extended-sequential Huffman SOF
+        if (seg_n < 6 || seg[0] != 8) return kErrFormat;  // 8-bit only
+        h = (seg[1] << 8) | seg[2];
+        w = (seg[3] << 8) | seg[4];
+        ncomp = seg[5];
+        if (w <= 0 || h <= 0 || ncomp != 3) return kErrColorspace;
+        if (seg_n < 6 + static_cast<size_t>(ncomp) * 3) return kErrFormat;
+        for (int c = 0; c < ncomp; ++c) {
+          comps[c].id = seg[6 + c * 3];
+          comps[c].h = seg[7 + c * 3] >> 4;
+          comps[c].v = seg[7 + c * 3] & 15;
+          comps[c].tq = seg[8 + c * 3];
+        }
+        break;
+      }
+      case 0xC2:
+        return kErrColorspace;  // progressive unsupported
+      case 0xDD: {  // DRI
+        if (seg_n < 2) return kErrFormat;
+        restart_interval = (seg[0] << 8) | seg[1];
+        break;
+      }
+      case 0xDA: {  // SOS
+        if (seg_n < 1) return kErrFormat;
+        const int ns = seg[0];
+        if (ns != ncomp || seg_n < 1 + static_cast<size_t>(ns) * 2 + 3)
+          return kErrFormat;
+        for (int s = 0; s < ns; ++s) {
+          const int cs = seg[1 + s * 2];
+          for (int c = 0; c < ncomp; ++c)
+            if (comps[c].id == cs) {
+              comps[c].td = seg[2 + s * 2] >> 4;
+              comps[c].ta = seg[2 + s * 2] & 15;
+            }
+        }
+        sos = true;
+        scan_start = p + seg_len;
+        break;
+      }
+      default:
+        break;  // APPn / COM / anything else: skip
+    }
+    p += seg_len;
+  }
+  if (w <= 0 || h <= 0) return kErrFormat;
+  // sampling: 4:2:0 = (2,2)(1,1)(1,1); 4:4:4 = all (1,1)
+  int sub;
+  if (comps[0].h == 2 && comps[0].v == 2 && comps[1].h == 1 &&
+      comps[1].v == 1 && comps[2].h == 1 && comps[2].v == 1) {
+    sub = 2;
+    if (w % 2 || h % 2) return kErrColorspace;  // match y4m 4:2:0
+  } else if (comps[0].h == 1 && comps[0].v == 1 && comps[1].h == 1 &&
+             comps[1].v == 1 && comps[2].h == 1 && comps[2].v == 1) {
+    sub = 1;
+  } else {
+    return kErrColorspace;
+  }
+  const int maxh = comps[0].h, maxv = comps[0].v;
+  const int mcus_x = (w + 8 * maxh - 1) / (8 * maxh);
+  const int mcus_y = (h + 8 * maxv - 1) / (8 * maxv);
+  for (int c = 0; c < ncomp; ++c) {
+    if (!qt_ok[comps[c].tq] || !hdc[comps[c].td].present ||
+        !hac[comps[c].ta].present)
+      return kErrFormat;
+    comps[c].plane_w = mcus_x * comps[c].h * 8;
+    comps[c].plane_h = mcus_y * comps[c].v * 8;
+    comps[c].plane.assign(
+        static_cast<size_t>(comps[c].plane_w) * comps[c].plane_h, 0);
+  }
+  BitReader br(data + scan_start, n - scan_start);
+  int dc_pred[3] = {0, 0, 0};
+  float blk[64];
+  int mcus_until_restart = restart_interval;
+  for (int my = 0; my < mcus_y; ++my) {
+    for (int mx = 0; mx < mcus_x; ++mx) {
+      if (restart_interval && mcus_until_restart == 0) {
+        if (!br.ConsumeRestart()) return kErrFormat;
+        dc_pred[0] = dc_pred[1] = dc_pred[2] = 0;
+        mcus_until_restart = restart_interval;
+      }
+      if (restart_interval) --mcus_until_restart;
+      for (int c = 0; c < ncomp; ++c) {
+        JpegComponent& comp = comps[c];
+        const unsigned short* q = qt[comp.tq];
+        for (int by = 0; by < comp.v; ++by) {
+          for (int bx = 0; bx < comp.h; ++bx) {
+            // entropy-decode one block
+            const int t = HuffDecode(&br, hdc[comp.td]);
+            if (t < 0 || t > 11) return kErrFormat;
+            const int diff = Extend(br.GetBits(t), t);
+            dc_pred[c] += diff;
+            std::memset(blk, 0, sizeof(blk));
+            blk[0] = static_cast<float>(dc_pred[c] * q[0]);
+            int k = 1, row_mask = 1;
+            bool ac_any = false;
+            const HuffTable& act = hac[comp.ta];
+            while (k < 64) {
+              // fused lookahead: symbol AND its value bits from one
+              // 24-bit peek when the 8-bit LUT hits (libjpeg-turbo's
+              // arrangement); falls back to the generic path otherwise
+              int rs;
+              const int look = br.Peek(24);
+              const unsigned short hit = act.lut[look >> 16];
+              if (hit) {
+                const int hlen = hit >> 8;
+                rs = hit & 0xFF;
+                const int s_ = rs & 15;
+                if (s_) {
+                  const int r_ = rs >> 4;
+                  k += r_;
+                  if (k > 63) return kErrFormat;
+                  const int vraw =
+                      (look >> (24 - hlen - s_)) & ((1 << s_) - 1);
+                  br.Drop(hlen + s_);
+                  const int nat = kZigzag[k];
+                  blk[nat] =
+                      static_cast<float>(Extend(vraw, s_) * q[k]);
+                  row_mask |= 1 << (nat >> 3);
+                  ac_any = true;
+                  ++k;
+                  continue;
+                }
+                br.Drop(hlen);
+              } else {
+                rs = HuffDecode(&br, act);
+                if (rs < 0) return kErrFormat;
+                const int s_ = rs & 15;
+                if (s_) {
+                  k += rs >> 4;
+                  if (k > 63) return kErrFormat;
+                  const int nat = kZigzag[k];
+                  blk[nat] = static_cast<float>(
+                      Extend(br.GetBits(s_), s_) * q[k]);
+                  row_mask |= 1 << (nat >> 3);
+                  ac_any = true;
+                  ++k;
+                  continue;
+                }
+              }
+              if ((rs >> 4) == 15) {
+                k += 16;  // ZRL
+                continue;
+              }
+              break;  // EOB
+            }
+            const int px = (mx * comp.h + bx) * 8;
+            const int py = (my * comp.v + by) * 8;
+            unsigned char* dst8 =
+                comp.plane.data() +
+                static_cast<size_t>(py) * comp.plane_w + px;
+            if (!ac_any) {
+              // DC-only block: the IDCT collapses to a flat fill
+              const float px0 = blk[0] * 0.125f + 128.0f;
+              const unsigned char flat =
+                  ClipByte(px0 < 0.f ? 0.f : (px0 + 0.5f));
+              for (int ry = 0; ry < 8; ++ry)
+                std::memset(dst8 + static_cast<size_t>(ry) * comp.plane_w,
+                            flat, 8);
+            } else {
+              Idct8x8(blk, row_mask, dst8, comp.plane_w);
+            }
+          }
+        }
+      }
+    }
+  }
+  // crop the MCU-padded planes into the packed y4m payload layout
+  const int cw = w / sub, chh = h / sub;
+  payload->resize(static_cast<size_t>(w) * h +
+                  2 * static_cast<size_t>(cw) * chh);
+  unsigned char* dst = payload->data();
+  for (int r = 0; r < h; ++r)
+    std::memcpy(dst + static_cast<size_t>(r) * w,
+                comps[0].plane.data() +
+                    static_cast<size_t>(r) * comps[0].plane_w,
+                w);
+  dst += static_cast<size_t>(w) * h;
+  for (int c = 1; c < 3; ++c) {
+    for (int r = 0; r < chh; ++r)
+      std::memcpy(dst + static_cast<size_t>(r) * cw,
+                  comps[c].plane.data() +
+                      static_cast<size_t>(r) * comps[c].plane_w,
+                  cw);
+    dst += static_cast<size_t>(cw) * chh;
+  }
+  *width = w;
+  *height = h;
+  *subsample = sub;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// MJPEG container: concatenated baseline JPEGs. Frame boundaries are
+// exact — inside entropy-coded data every 0xFF is followed by 0x00
+// stuffing or an RST marker, so a literal FF D9 always ends a frame.
+
+struct MjpegIndex {
+  int width = 0, height = 0, subsample = 1;
+  std::vector<long long> offsets;  // frame start (SOI)
+  std::vector<long long> lengths;  // through EOI
+  long long file_size = 0;
+  long long mtime_ns = 0;
+};
+
+int ScanMjpeg(const char* path, MjpegIndex* idx) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrIo;
+  if (fseeko(f, 0, SEEK_END) != 0) {
+    fclose(f);
+    return kErrIo;
+  }
+  const long long size = ftello(f);
+  std::vector<unsigned char> data(static_cast<size_t>(size));
+  if (fseeko(f, 0, SEEK_SET) != 0 ||
+      fread(data.data(), 1, data.size(), f) != data.size()) {
+    fclose(f);
+    return kErrIo;
+  }
+  fclose(f);
+  idx->offsets.clear();
+  idx->lengths.clear();
+  size_t p = 0;
+  const size_t n = data.size();
+  while (p + 3 < n) {
+    if (data[p] == 0xFF && data[p + 1] == 0xD8 && data[p + 2] == 0xFF) {
+      // scan for EOI from here
+      size_t q = p + 2;
+      size_t end = 0;
+      while (q + 1 < n) {
+        if (data[q] == 0xFF && data[q + 1] == 0xD9) {
+          end = q + 2;
+          break;
+        }
+        ++q;
+      }
+      if (!end) break;  // truncated trailing frame: drop it
+      idx->offsets.push_back(static_cast<long long>(p));
+      idx->lengths.push_back(static_cast<long long>(end - p));
+      p = end;
+    } else {
+      ++p;
+    }
+  }
+  if (idx->offsets.empty()) return kErrFormat;
+  // geometry from the first frame (MJPEG semantics: constant geometry)
+  int w, h, sub;
+  std::vector<unsigned char> payload;
+  const int rc = DecodeJpegFrame(
+      data.data() + idx->offsets[0],
+      static_cast<size_t>(idx->lengths[0]), &w, &h, &sub, &payload);
+  if (rc != 0) return rc;
+  idx->width = w;
+  idx->height = h;
+  idx->subsample = sub;
+  idx->file_size = size;
+  return 0;
+}
+
+// index cache: rescanning a multi-MB file per decode call would cost
+// more than the decode of a short clip list. Entries are validated by
+// (size, mtime) so an in-place regeneration of the file — even to the
+// same byte count — invalidates the cached frame offsets.
+std::mutex g_mjpeg_mu;
+std::map<std::string, MjpegIndex> g_mjpeg_cache;
+
+int StatFile(const char* path, long long* size, long long* mtime_ns) {
+  struct stat st;
+  if (stat(path, &st) != 0) return kErrIo;
+  *size = static_cast<long long>(st.st_size);
+  *mtime_ns = static_cast<long long>(st.st_mtim.tv_sec) * 1000000000ll +
+              st.st_mtim.tv_nsec;
+  return 0;
+}
+
+int GetMjpegIndex(const char* path, MjpegIndex* out) {
+  long long size, mtime_ns;
+  int rc = StatFile(path, &size, &mtime_ns);
+  if (rc != 0) return rc;
+  {
+    std::lock_guard<std::mutex> lk(g_mjpeg_mu);
+    auto it = g_mjpeg_cache.find(path);
+    if (it != g_mjpeg_cache.end() && it->second.file_size == size &&
+        it->second.mtime_ns == mtime_ns) {
+      *out = it->second;
+      return 0;
+    }
+  }
+  MjpegIndex idx;
+  rc = ScanMjpeg(path, &idx);
+  if (rc != 0) return rc;
+  idx.mtime_ns = mtime_ns;
+  {
+    std::lock_guard<std::mutex> lk(g_mjpeg_mu);
+    g_mjpeg_cache[path] = idx;
+  }
+  *out = idx;
+  return 0;
+}
+
+// 0 = y4m, 1 = mjpeg, <0 = error
+int SniffContainer(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrIo;
+  unsigned char magic[9] = {0};
+  const size_t got = fread(magic, 1, sizeof(magic), f);
+  fclose(f);
+  if (got >= 9 && std::memcmp(magic, "YUV4MPEG2", 9) == 0) return 0;
+  if (got >= 3 && magic[0] == 0xFF && magic[1] == 0xD8 &&
+      magic[2] == 0xFF)
+    return 1;
+  return kErrFormat;
+}
+
+int DecodeClipsMjpeg(const char* path, const long long* clip_starts,
+                     int num_clips, int consecutive, int out_w,
+                     int out_h, unsigned char* out, int pixfmt);
 
 // Convert one source frame payload into the caller's RGB output tile,
 // fusing nearest chroma upsample + box resize (out[r][c] samples
@@ -276,6 +863,11 @@ int DecodeClips(const char* path, const long long* clip_starts,
   if (pixfmt != kPixRgb && pixfmt != kPixYuv420) return kErrArg;
   if (pixfmt == kPixYuv420 && (out_w % 2 != 0 || out_h % 2 != 0))
     return kErrArg;  // packed 4:2:0 needs even output geometry
+  const int container = SniffContainer(path);
+  if (container < 0) return container;
+  if (container == 1)
+    return DecodeClipsMjpeg(path, clip_starts, num_clips, consecutive,
+                            out_w, out_h, out, pixfmt);
   Y4mMeta m;
   int rc = ProbeFile(path, &m);
   if (rc != 0) return rc;
@@ -315,6 +907,70 @@ int DecodeClips(const char* path, const long long* clip_starts,
       } else {
         // consecutive repeats of the clamped last frame: copy the
         // previous converted output instead of re-decoding
+        std::memcpy(dst, dst - frame_out, frame_out);
+      }
+    }
+  }
+  fclose(f);
+  return 0;
+}
+
+// MJPEG leg of DecodeClips: per needed frame, Huffman+IDCT-decode the
+// JPEG into a planar payload, then run the SAME fused convert/gather
+// as the y4m path. Clamp-past-end and repeat-frame memcpy semantics
+// are identical to the y4m leg (and the numpy backend).
+int DecodeClipsMjpeg(const char* path, const long long* clip_starts,
+                     int num_clips, int consecutive, int out_w,
+                     int out_h, unsigned char* out, int pixfmt) {
+  MjpegIndex idx;
+  int rc = GetMjpegIndex(path, &idx);
+  if (rc != 0) return rc;
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrIo;
+  Y4mMeta m;  // geometry carrier for the shared convert/gather stages
+  m.width = idx.width;
+  m.height = idx.height;
+  m.subsample = idx.subsample;
+  m.count = static_cast<long long>(idx.offsets.size());
+  std::vector<unsigned char> compressed, payload;
+  std::vector<int> col_map;
+  const long long frame_out =
+      pixfmt == kPixYuv420
+          ? static_cast<long long>(out_h) * out_w * 3 / 2
+          : static_cast<long long>(out_h) * out_w * 3;
+  long long last_idx = -1;
+  for (int ci = 0; ci < num_clips; ++ci) {
+    if (clip_starts[ci] < 0) {
+      fclose(f);
+      return kErrArg;
+    }
+    for (int fi = 0; fi < consecutive; ++fi) {
+      long long idx_f = clip_starts[ci] + fi;
+      if (idx_f > m.count - 1) idx_f = m.count - 1;
+      unsigned char* dst =
+          out + (static_cast<long long>(ci) * consecutive + fi) * frame_out;
+      if (idx_f != last_idx) {
+        compressed.resize(static_cast<size_t>(idx.lengths[idx_f]));
+        if (fseeko(f, idx.offsets[idx_f], SEEK_SET) != 0 ||
+            fread(compressed.data(), 1, compressed.size(), f) !=
+                compressed.size()) {
+          fclose(f);
+          return kErrIo;
+        }
+        int w, h, sub;
+        rc = DecodeJpegFrame(compressed.data(), compressed.size(), &w,
+                             &h, &sub, &payload);
+        if (rc != 0 || w != m.width || h != m.height ||
+            sub != m.subsample) {
+          fclose(f);
+          return rc != 0 ? rc : kErrFormat;
+        }
+        last_idx = idx_f;
+        if (pixfmt == kPixYuv420)
+          GatherFrameYUV(payload.data(), m, out_w, out_h, dst, &col_map);
+        else
+          ConvertFrame(payload.data(), m, out_w, out_h, dst, &col_map);
+      } else {
         std::memcpy(dst, dst - frame_out, frame_out);
       }
     }
@@ -414,6 +1070,18 @@ extern "C" {
 
 int rnb_y4m_probe(const char* path, int* width, int* height,
                   long long* num_frames) {
+  const int container = SniffContainer(path);
+  if (container < 0) return container;
+  if (container == 1) {
+    MjpegIndex idx;
+    const int rc = GetMjpegIndex(path, &idx);
+    if (rc != 0) return rc;
+    if (width) *width = idx.width;
+    if (height) *height = idx.height;
+    if (num_frames)
+      *num_frames = static_cast<long long>(idx.offsets.size());
+    return 0;
+  }
   Y4mMeta m;
   const int rc = ProbeFile(path, &m);
   if (rc != 0) return rc;
@@ -421,6 +1089,14 @@ int rnb_y4m_probe(const char* path, int* width, int* height,
   if (height) *height = m.height;
   if (num_frames) *num_frames = m.count;
   return 0;
+}
+
+// container-agnostic alias (y4m or mjpeg; sniffed). New export so a
+// stale prebuilt library (without mjpeg support) fails the symbol
+// check in rnb_tpu/decode/native.py and degrades cleanly.
+int rnb_video_probe(const char* path, int* width, int* height,
+                    long long* num_frames) {
+  return rnb_y4m_probe(path, width, height, num_frames);
 }
 
 int rnb_y4m_decode_clips(const char* path, const long long* clip_starts,

@@ -21,8 +21,8 @@ from rnb_tpu.decode import (DEFAULT_HEIGHT, DEFAULT_WIDTH, VideoDecoder)
 
 _ERR_MSGS = {
     -1: "I/O error",
-    -2: "not a y4m file / malformed header",
-    -3: "unsupported colourspace",
+    -2: "not a y4m/mjpeg file / malformed stream",
+    -3: "unsupported colourspace/sampling",
     -4: "bad argument",
 }
 
@@ -62,17 +62,20 @@ def load_native():
             return None
         # a stale prebuilt library missing newer exports must degrade
         # to the numpy backend like a missing library, not crash
+        # (rnb_video_probe marks mjpeg-capable builds)
         for sym in ("rnb_y4m_probe", "rnb_y4m_decode_clips",
                     "rnb_y4m_decode_clips_fmt", "rnb_pool_create",
                     "rnb_pool_destroy", "rnb_pool_submit",
                     "rnb_pool_submit_fmt", "rnb_pool_wait",
-                    "rnb_pool_peek"):
+                    "rnb_pool_peek", "rnb_video_probe"):
             if not hasattr(lib, sym):
                 return None
         lib.rnb_y4m_probe.restype = ctypes.c_int
         lib.rnb_y4m_probe.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_longlong)]
+        lib.rnb_video_probe.restype = ctypes.c_int
+        lib.rnb_video_probe.argtypes = lib.rnb_y4m_probe.argtypes
         lib.rnb_y4m_decode_clips.restype = ctypes.c_int
         lib.rnb_y4m_decode_clips.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
@@ -239,6 +242,11 @@ POOL_SPLIT_MIN_CLIPS = 4
 class NativeY4MDecoder(VideoDecoder):
     """VideoDecoder backed by the C++ library.
 
+    Despite the historical name this handles BOTH containers — the
+    library sniffs y4m vs MJPEG from the magic bytes, so .mjpg files
+    (self-contained baseline-JPEG decode, native/decode.cpp) ride the
+    same entry points, pool and pixel formats.
+
     Single-clip requests decode synchronously on the calling thread;
     larger requests split their clip list into chunks fanned out over
     the process-shared :class:`DecodePool`, each chunk writing a
@@ -259,8 +267,8 @@ class NativeY4MDecoder(VideoDecoder):
     def num_frames(self, video: str) -> int:
         if video not in self._count_cache:
             n = ctypes.c_longlong()
-            _check(self._lib.rnb_y4m_probe(video.encode(), None, None,
-                                           ctypes.byref(n)), video)
+            _check(self._lib.rnb_video_probe(video.encode(), None, None,
+                                             ctypes.byref(n)), video)
             self._count_cache[video] = int(n.value)
         return self._count_cache[video]
 

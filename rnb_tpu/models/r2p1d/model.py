@@ -971,10 +971,13 @@ class R2P1DAggregator(StageModel):
 class R2P1DVideoPathIterator(VideoPathIterator):
     """Cycles a video dataset forever (reference
     models/r2p1d/model.py:86-113 scanned a root/label/video tree).
-    Scans ``root`` (or $RNB_TPU_DATA_ROOT) for .y4m files; without a
-    dataset it cycles a fixed population of synthetic video ids, which
-    the decode layer resolves procedurally.
+    Scans ``root`` (or $RNB_TPU_DATA_ROOT) for video files (.y4m
+    uncompressed, .mjpg/.mjpeg compressed); without a dataset it cycles
+    a fixed population of synthetic video ids, which the decode layer
+    resolves procedurally.
     """
+
+    EXTENSIONS = (".y4m", ".mjpg", ".mjpeg")
 
     def __init__(self, root: Optional[str] = None,
                  num_synthetic: int = 200):
@@ -990,7 +993,7 @@ class R2P1DVideoPathIterator(VideoPathIterator):
                     videos.extend(
                         os.path.join(label_dir, v)
                         for v in sorted(os.listdir(label_dir))
-                        if v.endswith(".y4m"))
+                        if v.endswith(self.EXTENSIONS))
         if not videos:
             videos = ["synth://kinetics/video-%04d" % i
                       for i in range(num_synthetic)]

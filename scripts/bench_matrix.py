@@ -36,35 +36,46 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _cells(poisson_mi: int):
-    """(config, mean_interval_ms) cells; 0 = bulk max-throughput."""
+    """(config, mean_interval_ms, extra_env) cells; 0 = bulk
+    max-throughput. extra_env overrides bench.py env for that cell
+    (e.g. the compressed-decode dataset)."""
     return [
-        ("configs/r2p1d-whole.json", 0),
-        ("configs/r2p1d-whole.json", poisson_mi),
-        ("configs/r2p1d-whole-yuv.json", 0),
-        ("configs/rnb-1chip.json", 0),
-        ("configs/rnb-1chip.json", poisson_mi),
-        ("configs/rnb-1chip-yuv.json", 0),
-        ("configs/rnb-fused-yuv.json", 0),
-        ("configs/rnb-fused-yuv.json", poisson_mi),
+        ("configs/r2p1d-whole.json", 0, {}),
+        ("configs/r2p1d-whole.json", poisson_mi, {}),
+        ("configs/r2p1d-whole-yuv.json", 0, {}),
+        ("configs/rnb-1chip.json", 0, {}),
+        ("configs/rnb-1chip.json", poisson_mi, {}),
+        ("configs/rnb-1chip-yuv.json", 0, {}),
+        ("configs/rnb-fused-yuv.json", 0, {}),
+        ("configs/rnb-fused-yuv.json", poisson_mi, {}),
         # the fused-dispatch cap sweep (RESULTS.md "The cap sweep"):
         # -mid is the latency-SLO point, -big the bulk headline default
-        ("configs/rnb-fused-yuv-mid.json", 0),
-        ("configs/rnb-fused-yuv-mid.json", poisson_mi),
-        ("configs/rnb-fused-yuv-big.json", 0),
-        ("configs/rnb-fused-yuv-big.json", poisson_mi),
-        ("configs/r2p1d-nopipeline-1chip.json", 0),
-        ("configs/r2p1d-split-1chip.json", 0),
+        ("configs/rnb-fused-yuv-mid.json", 0, {}),
+        ("configs/rnb-fused-yuv-mid.json", poisson_mi, {}),
+        ("configs/rnb-fused-yuv-big.json", 0, {}),
+        ("configs/rnb-fused-yuv-big.json", poisson_mi, {}),
+        # compressed decode in the measured loop: baseline-JPEG
+        # entropy+IDCT per frame (native/decode.cpp), the role NVDEC
+        # filled for the reference — host-decode-bound by design on
+        # this 1-core host, so the cell is capped like the other slow
+        # ones
+        ("configs/rnb-fused-yuv-big.json", 0,
+         {"RNB_BENCH_DATASET": "mjpeg"}),
+        ("configs/r2p1d-nopipeline-1chip.json", 0, {}),
+        ("configs/r2p1d-split-1chip.json", 0, {}),
     ]
 
 
 # the fused single-stage baseline serializes decode -> transfer ->
 # compute per request (~5 videos/s through the tunnel); a full-length
 # cell would burn ~13 min of TPU time to prove a collapse 300 videos
-# already show with a ~60 s window
+# already show with a ~60 s window. The mjpeg cell is host-decode-bound
+# (~860 frames/s of real baseline-JPEG work on the 1-core host).
 SLOW_CONFIGS = {"configs/r2p1d-nopipeline-1chip.json": 300}
+SLOW_DATASETS = {"mjpeg": 2000}
 
 
-def run_cell(config: str, mi: int, videos: int) -> dict:
+def run_cell(config: str, mi: int, videos: int, extra_env=None) -> dict:
     """One fresh-process bench.py run; -> its JSON line as a dict."""
     env = dict(os.environ)
     env.update({
@@ -72,6 +83,7 @@ def run_cell(config: str, mi: int, videos: int) -> dict:
         "RNB_BENCH_MEAN_INTERVAL_MS": str(mi),
         "RNB_BENCH_VIDEOS": str(videos),
     })
+    env.update(extra_env or {})
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, env=env, cwd=REPO)
@@ -98,7 +110,7 @@ def main() -> int:
 
     rows = []
     backend_down = False
-    for config, mi in _cells(poisson_mi):
+    for config, mi, extra_env in _cells(poisson_mi):
         # Poisson cells run fewer videos: the arrival process adds idle
         # gaps, and the cell's job is the latency distribution, not a
         # long throughput window
@@ -107,6 +119,8 @@ def main() -> int:
         # distribution under load, but a too-short window is noise)
         n = videos if mi == 0 else max(200, videos // 2)
         n = min(n, SLOW_CONFIGS.get(config, n))
+        n = min(n, SLOW_DATASETS.get(
+            extra_env.get("RNB_BENCH_DATASET", ""), n))
         if backend_down:
             # don't burn a full probe budget per remaining cell once
             # one cell established the backend is unreachable
@@ -115,10 +129,10 @@ def main() -> int:
                          "error": "skipped: backend unavailable in an "
                                   "earlier cell"})
             continue
-        print("matrix: %s mi=%d videos=%d ..." % (config, mi, n),
-              file=sys.stderr)
+        print("matrix: %s mi=%d videos=%d %s..."
+              % (config, mi, n, extra_env or ""), file=sys.stderr)
         t0 = time.time()
-        row = run_cell(config, mi, n)
+        row = run_cell(config, mi, n, extra_env)
         row.setdefault("config", config)
         row.setdefault("mean_interval_ms", mi)
         row["cell_wall_s"] = round(time.time() - t0, 1)

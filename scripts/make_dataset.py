@@ -24,7 +24,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from rnb_tpu.decode import write_y4m  # noqa: E402
+from rnb_tpu.decode import write_mjpeg, write_y4m  # noqa: E402
 
 
 def synth_frames(num_frames: int, height: int, width: int,
@@ -58,6 +58,12 @@ def main(argv=None) -> int:
                         choices=("444", "420"),
                         help="y4m chroma format; 420 halves the bytes "
                              "per frame and matches real video")
+    parser.add_argument("--format", default="y4m",
+                        choices=("y4m", "mjpeg"),
+                        help="y4m = uncompressed; mjpeg = baseline-JPEG"
+                             " frames (real codec work at decode time)")
+    parser.add_argument("--quality", type=int, default=90,
+                        help="JPEG quality for --format mjpeg")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -67,11 +73,15 @@ def main(argv=None) -> int:
         label_dir = os.path.join(args.root, "label%03d" % li)
         os.makedirs(label_dir, exist_ok=True)
         for vi in range(args.videos_per_label):
-            path = os.path.join(label_dir, "video%04d.y4m" % vi)
             # sequence seed: collision-free for any label/video counts
             frames = synth_frames(args.frames, height, width,
                                   seed=[args.seed, li, vi])
-            write_y4m(path, frames, colorspace=args.colorspace)
+            if args.format == "mjpeg":
+                path = os.path.join(label_dir, "video%04d.mjpg" % vi)
+                write_mjpeg(path, frames, quality=args.quality)
+            else:
+                path = os.path.join(label_dir, "video%04d.y4m" % vi)
+                write_y4m(path, frames, colorspace=args.colorspace)
             count += 1
     print("wrote %d videos under %s" % (count, args.root))
     return 0
