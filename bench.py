@@ -494,6 +494,12 @@ def measure(config: str, num_videos: int, mean_interval: int,
         "p99_ms": (round(result.p99_latency_ms, 3)
                    if result.p99_latency_ms is not None else None),
         "latency_semantics": _latency_semantics(config_dict),
+        # host-core saturation over the measured window (1-core host:
+        # ~1.0 means the host is the ceiling) — the quantitative leg
+        # of any host-bound claim
+        "host_cpu_frac": (round(result.host_cpu_s / result.total_time_s,
+                                3)
+                          if result.total_time_s > 0 else None),
     }
     # device-utilization evidence: analytic conv+dense FLOPs (see
     # rnb_tpu/models/r2p1d/flops.py) x measured clip rate vs spec peak

@@ -269,7 +269,10 @@ inline int HuffDecode(BitReader* br, const HuffTable& t) {
   for (int l = 9; l <= 16; ++l) {
     code = (code << 1) | ((br->Peek(l) & 1));
     consumed = l;
-    if (t.maxcode[l] >= 0 && code <= t.maxcode[l]) {
+    // both bounds: a malformed DHT can otherwise admit codes below
+    // mincode[l], indexing values[] at a negative offset
+    if (t.maxcode[l] >= 0 && code >= t.mincode[l] &&
+        code <= t.maxcode[l]) {
       br->Drop(consumed);
       return t.values[t.valptr[l] + code - t.mincode[l]];
     }
@@ -593,8 +596,47 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
 
 // ---------------------------------------------------------------------------
 // MJPEG container: concatenated baseline JPEGs. Frame boundaries are
-// exact — inside entropy-coded data every 0xFF is followed by 0x00
-// stuffing or an RST marker, so a literal FF D9 always ends a frame.
+// found by walking the marker structure: length-prefixed segments are
+// skipped whole (an APPn/EXIF payload may legally contain FF D9 — a
+// thumbnail's EOI — so a raw byte scan would split mid-frame), and
+// only inside entropy-coded data (where every 0xFF is 0x00-stuffed or
+// an RST) is FF D9 unambiguous.
+
+// -> offset one past this frame's EOI, or 0 when the frame structure
+// is corrupt/truncated. d[p..] must start at an SOI.
+size_t JpegFrameEnd(const unsigned char* d, size_t n, size_t p) {
+  p += 2;  // SOI
+  while (p + 1 < n) {
+    if (d[p] != 0xFF) return 0;
+    while (p < n && d[p] == 0xFF) ++p;  // fill bytes
+    if (p >= n) return 0;
+    const unsigned char m = d[p++];
+    if (m == 0xD9) return p;  // EOI
+    if (m == 0x01 || (m >= 0xD0 && m <= 0xD7)) continue;  // TEM/RSTn
+    if (p + 2 > n) return 0;
+    const size_t len = (static_cast<size_t>(d[p]) << 8) | d[p + 1];
+    if (len < 2 || p + len > n) return 0;
+    const bool is_sos = (m == 0xDA);
+    p += len;
+    if (is_sos) {
+      // entropy-coded data: advance to the next real marker
+      while (p + 1 < n) {
+        if (d[p] != 0xFF) {
+          ++p;
+        } else if (d[p + 1] == 0x00 ||
+                   (d[p + 1] >= 0xD0 && d[p + 1] <= 0xD7)) {
+          p += 2;  // stuffing / restart
+        } else if (d[p + 1] == 0xFF) {
+          ++p;  // fill byte
+        } else {
+          break;  // real marker: handled by the loop top
+        }
+      }
+      if (p + 1 >= n) return 0;
+    }
+  }
+  return 0;
+}
 
 struct MjpegIndex {
   int width = 0, height = 0, subsample = 1;
@@ -625,16 +667,7 @@ int ScanMjpeg(const char* path, MjpegIndex* idx) {
   const size_t n = data.size();
   while (p + 3 < n) {
     if (data[p] == 0xFF && data[p + 1] == 0xD8 && data[p + 2] == 0xFF) {
-      // scan for EOI from here
-      size_t q = p + 2;
-      size_t end = 0;
-      while (q + 1 < n) {
-        if (data[q] == 0xFF && data[q + 1] == 0xD9) {
-          end = q + 2;
-          break;
-        }
-        ++q;
-      }
+      const size_t end = JpegFrameEnd(data.data(), n, p);
       if (!end) break;  // truncated trailing frame: drop it
       idx->offsets.push_back(static_cast<long long>(p));
       idx->lengths.push_back(static_cast<long long>(end - p));

@@ -68,6 +68,10 @@ class BenchmarkResult:
     #: total clips across every registered completion (0 when the
     #: pipeline never stamps num_clips) — clips/sec and MFU accounting
     clips_completed: int = 0
+    #: process CPU seconds (utime+stime, all threads incl. the decode
+    #: pool) over the measured window; / total_time_s ~ host-core
+    #: saturation on a 1-core host
+    host_cpu_s: float = 0.0
 
 
 def run_benchmark(config_path: str,
@@ -229,13 +233,30 @@ def run_benchmark(config_path: str,
         profiler.initialize(os.path.join(logroot(job_id, base=log_base),
                                          "xprof"))
         jax.block_until_ready(_marker(_marker_arg))
+    import resource
+
+    from rnb_tpu import hostprof
+    if hostprof.ENABLED:
+        # scope the section accumulator to THIS measured window — a
+        # multi-run process (config sweep) must not fold earlier runs'
+        # totals (or this run's warmup) into this run's report
+        hostprof.reset()
     sta_bar.wait()
+    ru_start = resource.getrusage(resource.RUSAGE_SELF)
     time_start = time.time()
     if print_progress:
         print("START! %f" % time_start)
 
     fin_bar.wait()
     time_end = time.time()
+    # host-core accounting over the measured window: on the 1-core
+    # bench host, (utime+stime)/wall ~ 1.0 means the host core is the
+    # ceiling — the quantitative side of any "host-bound" claim. Taken
+    # between the same barriers as the wall clock. Decode-pool threads
+    # are in-process, so their CPU time is included.
+    ru_end = resource.getrusage(resource.RUSAGE_SELF)
+    host_cpu_s = ((ru_end.ru_utime + ru_end.ru_stime)
+                  - (ru_start.ru_utime + ru_start.ru_stime))
     total_time = time_end - time_start
     if xprof:
         jax.block_until_ready(_marker(_marker_arg))  # end-of-window mark
@@ -306,6 +327,17 @@ def run_benchmark(config_path: str,
         print("Latency p50: %.3f ms  p99: %.3f ms (%d steady-state "
               "records)" % (p50, p99, len(latencies)))
 
+    if hostprof.ENABLED:
+        lines = hostprof.report_lines(total_time)
+        with open(os.path.join(logroot(job_id, base=log_base),
+                               "hostprof.txt"), "w") as f:
+            f.write("# wall_s %.3f host_cpu_s %.3f host_cpu_frac %.3f\n"
+                    % (total_time, host_cpu_s,
+                       host_cpu_s / total_time if total_time else 0.0))
+            f.write("\n".join(lines) + "\n")
+        if print_progress:
+            print("\n".join(lines))
+
     return BenchmarkResult(
         job_id=job_id,
         total_time_s=total_time,
@@ -317,6 +349,7 @@ def run_benchmark(config_path: str,
         p50_latency_ms=p50,
         p99_latency_ms=p99,
         clips_completed=clips_completed,
+        host_cpu_s=host_cpu_s,
     )
 
 

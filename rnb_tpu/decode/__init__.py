@@ -292,22 +292,65 @@ def write_y4m(path: str, frames: np.ndarray,
                 f.write(np.clip(plane, 0, 255).astype(np.uint8).tobytes())
 
 
+def _jpeg_frame_end(data: bytes, p: int) -> int:
+    """-> offset one past the frame's EOI, or 0 on corrupt/truncated
+    structure. ``data[p:]`` must start at an SOI."""
+    n = len(data)
+    p += 2  # SOI
+    while p + 1 < n:
+        if data[p] != 0xFF:
+            return 0
+        while p < n and data[p] == 0xFF:
+            p += 1  # fill bytes
+        if p >= n:
+            return 0
+        m = data[p]
+        p += 1
+        if m == 0xD9:
+            return p  # EOI
+        if m == 0x01 or 0xD0 <= m <= 0xD7:
+            continue  # TEM / RSTn: no length field
+        if p + 2 > n:
+            return 0
+        length = (data[p] << 8) | data[p + 1]
+        if length < 2 or p + length > n:
+            return 0
+        is_sos = m == 0xDA
+        p += length
+        if is_sos:
+            # entropy-coded data: only here is FFD9 unambiguous
+            while True:
+                q = data.find(b"\xff", p)
+                if q < 0 or q + 1 >= n:
+                    return 0
+                nm = data[q + 1]
+                if nm == 0x00 or 0xD0 <= nm <= 0xD7:
+                    p = q + 2  # stuffing / restart
+                elif nm == 0xFF:
+                    p = q + 1  # fill byte
+                else:
+                    p = q
+                    break  # real marker: handled by the loop top
+    return 0
+
+
 def scan_mjpeg_frames(data: bytes):
     """-> [(offset, length)] of the JPEG frames in an MJPEG byte
-    stream. Boundaries are exact: inside entropy-coded data every 0xFF
-    is followed by 0x00 stuffing or an RST marker, so a literal FFD9
-    always terminates a frame. Shared logic with the native scanner
-    (native/decode.cpp ScanMjpeg)."""
+    stream. Walks the marker structure: length-prefixed segments are
+    skipped whole (an APPn/EXIF payload may legally embed a
+    thumbnail's FFD9, so a raw byte scan would split mid-frame).
+    Shared logic with the native scanner (native/decode.cpp
+    JpegFrameEnd/ScanMjpeg)."""
     frames = []
     p = 0
     n = len(data)
     while p + 2 < n:
         if data[p] == 0xFF and data[p + 1] == 0xD8 and data[p + 2] == 0xFF:
-            end = data.find(b"\xff\xd9", p + 2)
-            if end < 0:
+            end = _jpeg_frame_end(data, p)
+            if not end:
                 break  # truncated trailing frame: drop it
-            frames.append((p, end + 2 - p))
-            p = end + 2
+            frames.append((p, end - p))
+            p = end
         else:
             p += 1
     return frames
@@ -426,8 +469,16 @@ def write_mjpeg(path: str, frames: np.ndarray, quality: int = 90) -> None:
             f.write(buf.getvalue())
 
 
+#: backend instances are shared per process: get_decoder runs once per
+#: request, and a fresh instance each time would defeat every decoder's
+#: per-video metadata cache (header/frame-index parses would repeat on
+#: each request). The caches inside are per-video metadata only.
+_DECODER_CACHE: dict = {}
+
+
 def get_decoder(video: str) -> VideoDecoder:
-    """Pick a backend for one video path/id.
+    """Pick a backend for one video path/id (instances shared
+    per-process).
 
     .y4m and .mjpg/.mjpeg files prefer the native C++ worker-pool
     decoder when built (``make -C native``; disable with
@@ -435,13 +486,27 @@ def get_decoder(video: str) -> VideoDecoder:
     identical numerics / the PIL-based MJPEG backend.
     """
     if video.startswith(SYNTH_PREFIX) or not os.path.exists(video):
-        return SyntheticDecoder()
-    if video.endswith((".y4m", ".mjpg", ".mjpeg")):
-        from rnb_tpu.decode.native import NativeY4MDecoder, native_available
+        key = "synth"
+    elif video.endswith((".y4m", ".mjpg", ".mjpeg")):
+        from rnb_tpu.decode.native import native_available
         if native_available():
-            return NativeY4MDecoder()
-        return (Y4MDecoder() if video.endswith(".y4m")
-                else MjpegPILDecoder())
-    raise ValueError(
-        "no decode backend for %r: only synth:// ids, .y4m and "
-        ".mjpg/.mjpeg files are supported" % video)
+            key = "native"
+        else:
+            key = "y4m" if video.endswith(".y4m") else "mjpeg-pil"
+    else:
+        raise ValueError(
+            "no decode backend for %r: only synth:// ids, .y4m and "
+            ".mjpg/.mjpeg files are supported" % video)
+    dec = _DECODER_CACHE.get(key)
+    if dec is None:
+        if key == "synth":
+            dec = SyntheticDecoder()
+        elif key == "native":
+            from rnb_tpu.decode.native import NativeY4MDecoder
+            dec = NativeY4MDecoder()
+        elif key == "y4m":
+            dec = Y4MDecoder()
+        else:
+            dec = MjpegPILDecoder()
+        _DECODER_CACHE[key] = dec
+    return dec

@@ -1,0 +1,101 @@
+"""Opt-in host hot-path micro-profiler (``RNB_HOST_PROFILE=1``).
+
+The benchmark's MFU ceiling question is a host question: on a 1-core
+bench host every Python executor thread, the decode pool and the
+transfer path share one core, so "which host component eats the core"
+decides whether more device throughput is even reachable. This module
+gives the hot paths named wall-time sections with negligible cost when
+disabled (one module-level bool test) and ~100 ns per section when
+enabled, aggregated per (section, thread role).
+
+Wall-time sections measure where threads SPEND TIME (including waits:
+decode-pool wait, device wait); the companion evidence for "the host
+core is saturated" is process CPU time over the measured window
+(``rusage_window`` in rnb_tpu.benchmark — always on, reported as
+``host_cpu_frac``). The two together separate "host busy" from "host
+waiting on device/decode".
+
+The reference had no analog — its per-process stages made the host
+cost visible in nvidia-smi/top; a single-process threaded runtime
+needs explicit accounting.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+#: evaluated at import; tests flip it directly
+ENABLED = bool(os.environ.get("RNB_HOST_PROFILE"))
+
+_lock = threading.Lock()
+_acc: Dict[str, List[float]] = {}  # name -> [total_s, calls]
+
+
+def add(name: str, dt: float) -> None:
+    with _lock:
+        entry = _acc.get(name)
+        if entry is None:
+            _acc[name] = [dt, 1]
+        else:
+            entry[0] += dt
+            entry[1] += 1
+
+
+class _NullSection:
+    """Shared no-op context manager: the disabled path costs one
+    function call and no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSection()
+
+
+@contextmanager
+def _timed(name: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        add(name, time.perf_counter() - t0)
+
+
+def section(name: str):
+    if not ENABLED:
+        return _NULL
+    return _timed(name)
+
+
+def reset() -> None:
+    with _lock:
+        _acc.clear()
+
+
+def snapshot() -> Dict[str, Tuple[float, int]]:
+    with _lock:
+        return {k: (v[0], v[1]) for k, v in _acc.items()}
+
+
+def report_lines(wall_s: float) -> List[str]:
+    """Human table: per-section total seconds, share of the window,
+    call count and per-call mean, sorted by total."""
+    snap = snapshot()
+    lines = ["%-28s %9s %6s %10s %10s"
+             % ("section", "total_s", "pct", "calls", "mean_us")]
+    for name, (total, calls) in sorted(snap.items(),
+                                       key=lambda kv: -kv[1][0]):
+        lines.append("%-28s %9.3f %5.1f%% %10d %10.1f"
+                     % (name, total,
+                        100.0 * total / wall_s if wall_s else 0.0,
+                        calls, 1e6 * total / calls if calls else 0.0))
+    return lines

@@ -348,12 +348,14 @@ class R2P1DLoader(StageModel):
         small fallback thread pool. Either way the calling executor
         thread returns without blocking on pixel work.
         """
+        from rnb_tpu import hostprof
         video = str(non_tensors)
-        decoder = get_decoder(video)
-        length = decoder.num_frames(video)
-        starts = [int(s) for s in
-                  self.sampler.sample(length, video_id=video)]
-        starts = starts[: self.max_clips]
+        with hostprof.section("loader.probe+sample"):
+            decoder = get_decoder(video)
+            length = decoder.num_frames(video)
+            starts = [int(s) for s in
+                      self.sampler.sample(length, video_id=video)]
+            starts = starts[: self.max_clips]
         n = len(starts)
         time_card.num_clips = n
         # trust the backend get_decoder() chose: a .y4m path whose file
@@ -369,12 +371,13 @@ class R2P1DLoader(StageModel):
             pool = DecodePool.shared()
             tickets = []
             try:
-                for lo in range(0, n, self.POOL_CHUNK_CLIPS):
-                    hi = min(lo + self.POOL_CHUNK_CLIPS, n)
-                    tickets.append(pool.submit_into(
-                        video, starts[lo:hi], self.consecutive_frames,
-                        out[lo:hi], pixfmt=pixfmt, width=FRAME_HW,
-                        height=FRAME_HW))
+                with hostprof.section("loader.pool_submit"):
+                    for lo in range(0, n, self.POOL_CHUNK_CLIPS):
+                        hi = min(lo + self.POOL_CHUNK_CLIPS, n)
+                        tickets.append(pool.submit_into(
+                            video, starts[lo:hi], self.consecutive_frames,
+                            out[lo:hi], pixfmt=pixfmt, width=FRAME_HW,
+                            height=FRAME_HW))
             except Exception:
                 # a partial submit must not leak the earlier tickets —
                 # un-waited tickets pin the batch buffer in the pool's
@@ -506,6 +509,8 @@ class R2P1DFusingLoader(R2P1DLoader):
         """Fuse ready requests (up to ``fuse`` / the ring max rows)
         into one padded batch + TimeCardList."""
         import jax
+
+        from rnb_tpu import hostprof
         cap = self.max_clips
         take, rows = [], 0
         while self._ready and len(take) < self.fuse:
@@ -519,16 +524,20 @@ class R2P1DFusingLoader(R2P1DLoader):
         # of surfacing the broken invariant
         assert rows <= cap, (rows, cap)
         bucket = self._bucket_for(rows)
-        out = np.zeros(self._batch_shape(bucket), dtype=np.uint8)
+        with hostprof.section("loader.emit_alloc"):
+            out = np.zeros(self._batch_shape(bucket), dtype=np.uint8)
         cards, row = [], 0
-        for handle, video, tc, _ in take:
-            handle.wait(video)
-            out[row:row + handle.n] = handle.out[: handle.n]
-            row += handle.n
-            cards.append(tc)
-        batch = jax.device_put(out, self._jax_device)
+        with hostprof.section("loader.emit_wait+copy"):
+            for handle, video, tc, _ in take:
+                handle.wait(video)
+                out[row:row + handle.n] = handle.out[: handle.n]
+                row += handle.n
+                cards.append(tc)
+        with hostprof.section("loader.device_put"):
+            batch = jax.device_put(out, self._jax_device)
         if self._preprocess is not None:
-            batch = self._preprocess(batch)
+            with hostprof.section("loader.preprocess_dispatch"):
+                batch = self._preprocess(batch)
         from rnb_tpu.telemetry import TimeCardList
         return ((PaddedBatch(batch, row),), None, TimeCardList(cards))
 

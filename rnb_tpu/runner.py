@@ -36,6 +36,7 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from rnb_tpu import hostprof
 from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
                              InferenceCounter, Signal, TerminationFlag,
                              TerminationState, send_exit_markers)
@@ -194,6 +195,13 @@ def runner(ctx: RunnerContext) -> None:
     # accumulator stages expose poll() for the idle tick; resolve once
     idle_poll = getattr(model, "poll", None)
     old_counter_value = 0
+    # loop-invariant hostprof section names, formatted once
+    sec_queue_get = "exec%d.queue_get" % ctx.step_idx
+    sec_model_call = "exec%d.model_call" % ctx.step_idx
+    sec_device_sync = "exec%d.device_sync" % ctx.step_idx
+    sec_ring_publish = "exec%d.ring_publish" % ctx.step_idx
+    sec_bookkeeping = "exec%d.bookkeeping" % ctx.step_idx
+    sec_enqueue = "exec%d.route+enqueue" % ctx.step_idx
 
     # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
     # first stage exposing submit()/complete() gets its next requests'
@@ -253,7 +261,8 @@ def runner(ctx: RunnerContext) -> None:
                         continue
                 else:
                     try:
-                        item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
+                        with hostprof.section(sec_queue_get):
+                            item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
                     except queue.Empty:
                         # idle tick: give accumulator stages (fusing
                         # loader) a chance to emit on hold-timeout —
@@ -299,12 +308,14 @@ def runner(ctx: RunnerContext) -> None:
                     tensors_out, non_tensors_out, time_card = flushed
                 else:
                     time_card.record("inference%d_start" % ctx.step_idx)
-                    if handle is not None:
-                        tensors_out, non_tensors_out, time_card = \
-                            model.complete(handle, non_tensors, time_card)
-                    else:
-                        tensors_out, non_tensors_out, time_card = model(
-                            tensors, non_tensors, time_card)
+                    with hostprof.section(sec_model_call):
+                        if handle is not None:
+                            tensors_out, non_tensors_out, time_card = \
+                                model.complete(handle, non_tensors,
+                                               time_card)
+                        else:
+                            tensors_out, non_tensors_out, time_card = \
+                                model(tensors, non_tensors, time_card)
                     if time_card is None:
                         # stage swallowed the item (accumulating batcher
                         # / aggregator) — nothing moves downstream
@@ -313,18 +324,22 @@ def runner(ctx: RunnerContext) -> None:
                                  "step %d %s" % (ctx.step_idx,
                                                  ctx.model_class_path))
                 if ctx.sync_outputs and tensors_out:
-                    _block_on(tensors_out)
+                    with hostprof.section(sec_device_sync):
+                        _block_on(tensors_out)
                 time_card.record("inference%d_finish" % ctx.step_idx)
 
                 if ctx.output_ring is not None:
-                    segments = split_segments(tensors_out, ctx.num_segments)
-                    for seg_idx, seg_payload in enumerate(segments):
-                        slot_idx = (ring_counter + seg_idx) \
-                            % len(ctx.output_ring)
-                        if not ctx.output_ring.wait_free(
-                                slot_idx, ctx.termination):
-                            break
-                        ctx.output_ring.slots[slot_idx].write(seg_payload)
+                    with hostprof.section(sec_ring_publish):
+                        segments = split_segments(tensors_out,
+                                                  ctx.num_segments)
+                        for seg_idx, seg_payload in enumerate(segments):
+                            slot_idx = (ring_counter + seg_idx) \
+                                % len(ctx.output_ring)
+                            if not ctx.output_ring.wait_free(
+                                    slot_idx, ctx.termination):
+                                break
+                            ctx.output_ring.slots[slot_idx].write(
+                                seg_payload)
                     if ctx.termination.terminated:
                         break
 
@@ -336,16 +351,19 @@ def runner(ctx: RunnerContext) -> None:
                     # the flag while this one was mid-inference — the
                     # reference registered every completed record
                     # (reference runner.py:176-202)
-                    n = len(time_card) if isinstance(time_card,
-                                                     TimeCardList) else 1
-                    old, new = ctx.counter.add(n)
-                    if progress_bar is not None and new > old_counter_value:
-                        progress_bar.update(new - old_counter_value)
-                        old_counter_value = new
-                    cards = time_card.time_cards if isinstance(
-                        time_card, TimeCardList) else [time_card]
-                    for tc in cards:
-                        summary.register(tc)
+                    with hostprof.section(sec_bookkeeping):
+                        n = len(time_card) if isinstance(time_card,
+                                                         TimeCardList) \
+                            else 1
+                        old, new = ctx.counter.add(n)
+                        if progress_bar is not None \
+                                and new > old_counter_value:
+                            progress_bar.update(new - old_counter_value)
+                            old_counter_value = new
+                        cards = time_card.time_cards if isinstance(
+                            time_card, TimeCardList) else [time_card]
+                        for tc in cards:
+                            summary.register(tc)
                     if new >= ctx.num_videos:
                         if old < ctx.num_videos:
                             ctx.termination.raise_flag(
@@ -353,22 +371,24 @@ def runner(ctx: RunnerContext) -> None:
                         else:
                             break  # someone else already hit the target
                 else:
-                    out_idx = selector.select(tensors_out, non_tensors_out,
-                                              time_card)
-                    out_queue = ctx.out_queues[out_idx]
                     try:
-                        for seg_idx in range(ctx.num_segments):
-                            forked = time_card.fork(seg_idx) \
-                                if ctx.num_segments > 1 else time_card
-                            if ctx.output_ring is not None:
-                                sig = Signal(ctx.group_idx,
-                                             ctx.instance_idx, ring_counter)
-                                ring_counter = (ring_counter + 1) \
-                                    % len(ctx.output_ring)
-                            else:
-                                sig = None
-                            out_queue.put_nowait(
-                                (sig, non_tensors_out, forked))
+                        with hostprof.section(sec_enqueue):
+                            out_idx = selector.select(
+                                tensors_out, non_tensors_out, time_card)
+                            out_queue = ctx.out_queues[out_idx]
+                            for seg_idx in range(ctx.num_segments):
+                                forked = time_card.fork(seg_idx) \
+                                    if ctx.num_segments > 1 else time_card
+                                if ctx.output_ring is not None:
+                                    sig = Signal(ctx.group_idx,
+                                                 ctx.instance_idx,
+                                                 ring_counter)
+                                    ring_counter = (ring_counter + 1) \
+                                        % len(ctx.output_ring)
+                                else:
+                                    sig = None
+                                out_queue.put_nowait(
+                                    (sig, non_tensors_out, forked))
                     except queue.Full:
                         print("[WARNING] queue between steps %d and %d is "
                               "full; aborting"

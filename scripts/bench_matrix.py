@@ -61,6 +61,10 @@ def _cells(poisson_mi: int):
         # ones
         ("configs/rnb-fused-yuv-big.json", 0,
          {"RNB_BENCH_DATASET": "mjpeg"}),
+        # torch-checkpoint-compatible network (factored 1x1x1
+        # downsampling shortcuts): same topology as -big, so the delta
+        # is the cost of serving converted reference checkpoints
+        ("configs/rnb-fused-yuv-big-torchckpt.json", 0, {}),
         ("configs/r2p1d-nopipeline-1chip.json", 0, {}),
         ("configs/r2p1d-split-1chip.json", 0, {}),
     ]

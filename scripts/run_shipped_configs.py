@@ -76,17 +76,29 @@ def main():
               % (name, row["ok"], row["wall_s"],
                  row.get("error", "")), flush=True)
 
-    result = {"generated": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                         time.gmtime()),
-              "n_devices": 8, "platform": "cpu-virtual",
-              "configs": rows,
-              "all_ok": all(r["ok"] for r in rows)}
-    if args.only is None:
-        with open(OUT_PATH, "w") as f:
-            json.dump(result, f, indent=1)
-        print("wrote %s (all_ok=%s)" % (OUT_PATH, result["all_ok"]))
+    if args.only is not None and os.path.exists(OUT_PATH):
+        # merge a partial sweep into the existing artifact by config
+        # name (e.g. one newly added config without re-running all);
+        # rows for configs no longer on disk are dropped so a stale
+        # failure can't poison all_ok forever
+        with open(OUT_PATH) as f:
+            result = json.load(f)
+        shipped = {os.path.relpath(p, REPO)
+                   for p in glob.glob(os.path.join(REPO, "configs",
+                                                   "*.json"))}
+        by_name = {r["config"]: r for r in result.get("configs", [])
+                   if r.get("config") in shipped}
+        by_name.update({r["config"]: r for r in rows})
+        result["configs"] = [by_name[k] for k in sorted(by_name)]
     else:
-        print(json.dumps(result, indent=1))
+        result = {"n_devices": 8, "platform": "cpu-virtual",
+                  "configs": rows}
+    result["generated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())
+    result["all_ok"] = all(r["ok"] for r in result["configs"])
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    print("wrote %s (all_ok=%s)" % (OUT_PATH, result["all_ok"]))
     return 0 if result["all_ok"] else 1
 
 

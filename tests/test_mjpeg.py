@@ -220,6 +220,38 @@ def test_unsupported_jpegs_fail_cleanly(tmp_path):
         nd.decode_clips(pprog, [0], 1, width=W, height=H)
 
 
+def test_app_segment_with_embedded_eoi_not_split(tmp_path):
+    """An APPn payload may legally contain FF D9 (e.g. an EXIF
+    thumbnail's end-of-image); the scanner must skip segments by their
+    length fields, not split at the first raw FF D9."""
+    from PIL import Image
+    frames = synth_frames(2, H, W, seed=[4, 4, 4])
+    blobs = []
+    for i in range(2):
+        buf = io.BytesIO()
+        Image.fromarray(frames[i], "RGB").save(buf, "JPEG", quality=90,
+                                               subsampling=2)
+        b = buf.getvalue()
+        # inject an APP1 right after SOI whose payload embeds FFD8+FFD9
+        payload = b"Exif\x00\x00" + b"\xff\xd8" + b"A" * 10 + b"\xff\xd9"
+        app1 = b"\xff\xe1" + (len(payload) + 2).to_bytes(2, "big") + payload
+        blobs.append(b[:2] + app1 + b[2:])
+    path = str(tmp_path / "exif.mjpg")
+    with open(path, "wb") as f:
+        f.write(b"".join(blobs))
+    with open(path, "rb") as f:
+        scanned = scan_mjpeg_frames(f.read())
+    assert len(scanned) == 2
+    assert scanned[0][1] == len(blobs[0])
+    if native_available():
+        nd = NativeY4MDecoder()
+        assert nd.num_frames(path) == 2
+        out = nd.decode_clips(path, [0], 2, width=W, height=H)
+        assert out.shape == (1, 2, H, W, 3)
+    # the PIL fallback consumes the same boundaries
+    assert MjpegPILDecoder().num_frames(path) == 2
+
+
 def test_path_iterator_picks_up_mjpg(tmp_path, monkeypatch):
     from rnb_tpu.models.r2p1d.model import R2P1DVideoPathIterator
     label = tmp_path / "label000"
