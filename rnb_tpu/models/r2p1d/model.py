@@ -1018,13 +1018,34 @@ class LargeSmallSelector(QueueSelector):
     everything else to queue 0, so small videos can be batched without
     head-of-line blocking — the Replicate & Batch placement policy
     (reference models/r2p1d/model.py:288-296). Keyed off the
-    ``num_clips`` the loader stamped on the TimeCard."""
+    ``num_clips`` the loader stamped on the TimeCard.
+
+    The "large" threshold binds to the producing loader's configured
+    clip population (``bind_stage``): a config sampling
+    ``num_clips_population`` != the default [1, 15] still routes its
+    own largest class to the dedicated lane. Falls back to the module
+    default when the stage exposes no sampler."""
 
     def __init__(self, num_queues: int):
         super().__init__(num_queues)
         if num_queues != 2:
             raise ValueError("LargeSmallSelector routes over exactly two "
                              "queues (got %d)" % num_queues)
+        self._threshold = MAX_CLIPS
+
+    def bind_stage(self, model) -> None:
+        sampler = getattr(model, "sampler", None)
+        threshold = getattr(sampler, "max_clips", None)
+        if threshold:
+            # the loader truncates every request at its own max_clips
+            # cap (submit/__call__ starts[:max_clips]), so a population
+            # max above the cap would be an unreachable threshold and
+            # the large lane would starve
+            cap = getattr(model, "max_clips", None)
+            if cap:
+                threshold = min(int(threshold), int(cap))
+            self._threshold = int(threshold)
 
     def select(self, tensors, non_tensors, time_card) -> int:
-        return 1 if getattr(time_card, "num_clips", 0) >= MAX_CLIPS else 0
+        return (1 if getattr(time_card, "num_clips", 0) >= self._threshold
+                else 0)

@@ -174,6 +174,7 @@ def runner(ctx: RunnerContext) -> None:
         if ctx.out_queues is not None:
             selector_class = load_class(ctx.queue_selector_path)
             selector = selector_class(len(ctx.out_queues))
+            selector.bind_stage(model)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)

@@ -18,6 +18,13 @@ class QueueSelector:
     def __init__(self, num_queues: int):
         self.num_queues = num_queues
 
+    def bind_stage(self, model) -> None:
+        """Called once by the executor with the producing stage model,
+        before the hot loop. Content-aware selectors read their
+        thresholds from the stage's configuration here (e.g. the
+        loader's configured clip population) instead of hardcoding
+        module constants that silently diverge from the config."""
+
     def select(self, tensors, non_tensors, time_card) -> int:
         raise NotImplementedError
 

@@ -210,6 +210,33 @@ def test_large_small_selector():
         LargeSmallSelector(3)
 
 
+def test_large_small_selector_binds_to_configured_population():
+    """A non-default clip population must move the 'large' threshold
+    with it (previously hardcoded to the module constant: a [1, 4]
+    population silently routed everything to queue 0)."""
+
+    class FakeSampler:
+        max_clips = 4
+
+    class FakeLoader:
+        sampler = FakeSampler()
+
+    sel = LargeSmallSelector(2)
+    sel.bind_stage(FakeLoader())
+    mid = TimeCard(0)
+    mid.num_clips = 4
+    small = TimeCard(1)
+    small.num_clips = 3
+    assert sel.select(None, None, mid) == 1
+    assert sel.select(None, None, small) == 0
+    # stages without a sampler keep the default threshold
+    sel2 = LargeSmallSelector(2)
+    sel2.bind_stage(object())
+    big = TimeCard(2)
+    big.num_clips = MAX_CLIPS
+    assert sel2.select(None, None, big) == 1
+
+
 def test_video_path_iterator_cycles_synthetic():
     it = iter(R2P1DVideoPathIterator(num_synthetic=3))
     seen = [next(it) for _ in range(7)]

@@ -190,13 +190,14 @@ def test_bench_matrix_short_circuits_on_backend_down(tmp_path,
 
     calls = []
 
-    def fake_run_cell(config, mi, videos):
+    def fake_run_cell(config, mi, videos, extra_env=None):
         calls.append(config)
         if len(calls) == 1:
             return {"metric": "videos_per_sec", "value": 5.0,
                     "config": config, "mean_interval_ms": mi,
                     "num_videos": videos, "platform": "cpu",
-                    "decode_backend": "native-y4m"}
+                    "decode_backend": "native-y4m",
+                    "p50_ms": 4000.0, "p99_ms": 9000.0}
         return {"config": config, "mean_interval_ms": mi,
                 "error": "backend unavailable after 3 probe(s)"}
 

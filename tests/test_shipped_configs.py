@@ -1,0 +1,39 @@
+"""Every shipped config must have EXECUTED end-to-end at least once.
+
+The reference shipped config/r2p1d-segment.json broken for years
+because its sanity_check only parsed. Here scripts/run_shipped_configs.py
+runs each configs/*.json through run_benchmark on the 8-virtual-device
+CPU backend and records one row per config in MULTICHIP_CONFIGS.json;
+this test pins the committed artifact to the shipped set, so adding a
+config without ever executing it (or committing a failing sweep) fails
+the suite. Re-run the sweep — full, or ``--only <new-config>.json`` to
+merge one row — whenever configs change.
+"""
+
+import glob
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "MULTICHIP_CONFIGS.json")
+
+
+def test_every_shipped_config_has_an_ok_execution_row():
+    assert os.path.exists(ARTIFACT), (
+        "MULTICHIP_CONFIGS.json missing — run "
+        "scripts/run_shipped_configs.py")
+    with open(ARTIFACT) as f:
+        artifact = json.load(f)
+    rows = {r["config"]: r for r in artifact["configs"]}
+    shipped = sorted(
+        os.path.relpath(p, REPO)
+        for p in glob.glob(os.path.join(REPO, "configs", "*.json")))
+    missing = [c for c in shipped if c not in rows]
+    assert not missing, (
+        "configs never executed end-to-end: %s — run "
+        "scripts/run_shipped_configs.py --only '<name>.json'" % missing)
+    failed = [c for c in shipped if not rows[c].get("ok")]
+    assert not failed, (
+        "configs whose last end-to-end execution failed: %s (see "
+        "MULTICHIP_CONFIGS.json for the error rows)" % failed)
+    assert artifact["all_ok"] is True
