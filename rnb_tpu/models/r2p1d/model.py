@@ -241,6 +241,7 @@ class R2P1DLoader(StageModel):
                              "clip axis")
         self.prefetch_depth = int(prefetch)
         self._fallback_pool = None  # lazily built thread pool
+        self._starts_cache = {}  # video -> clip starts (see _sample_starts)
         if self.raw_output or self.pixel_path == "yuv420":
             # raw mode: consumer normalizes on its mesh. yuv420: the
             # network stage's jit owns the whole ingest; the loader
@@ -340,6 +341,29 @@ class R2P1DLoader(StageModel):
     #: enough that 1-clip videos cost one submit/wait round trip
     POOL_CHUNK_CLIPS = 4
 
+    #: per-video clip-start cache cap: benchmark datasets cycle a small
+    #: id population; anything larger falls back to re-sampling
+    STARTS_CACHE_MAX = 8192
+
+    def _sample_starts(self, decoder, video: str):
+        """Clip starts for one video — cached. The sampler is
+        deterministic per video id (sampler.py seeds per id) and a
+        file's frame count is fixed, so a repeated id re-derives
+        identical starts; before caching, the probe+sample path cost
+        ~200 us/request = 20% of the host core at ~1k videos/s
+        (hostprof, round 5). A file replaced on disk mid-run keeps its
+        cached starts — benchmark semantics, same as the native
+        decoder's per-video metadata caches."""
+        starts = self._starts_cache.get(video)
+        if starts is None:
+            length = decoder.num_frames(video)
+            starts = [int(s) for s in
+                      self.sampler.sample(length, video_id=video)]
+            starts = starts[: self.max_clips]
+            if len(self._starts_cache) < self.STARTS_CACHE_MAX:
+                self._starts_cache[video] = starts
+        return starts
+
     def submit(self, non_tensors, time_card) -> _DecodeHandle:
         """Kick off decode of one request; pair with :meth:`complete`.
 
@@ -352,10 +376,7 @@ class R2P1DLoader(StageModel):
         video = str(non_tensors)
         with hostprof.section("loader.probe+sample"):
             decoder = get_decoder(video)
-            length = decoder.num_frames(video)
-            starts = [int(s) for s in
-                      self.sampler.sample(length, video_id=video)]
-            starts = starts[: self.max_clips]
+            starts = self._sample_starts(decoder, video)
         n = len(starts)
         time_card.num_clips = n
         # trust the backend get_decoder() chose: a .y4m path whose file
@@ -443,9 +464,7 @@ class R2P1DLoader(StageModel):
         # extra staging copy on the hot path
         video = str(non_tensors)
         decoder = get_decoder(video)
-        length = decoder.num_frames(video)
-        starts = self.sampler.sample(length, video_id=video)
-        starts = starts[: self.max_clips]
+        starts = self._sample_starts(decoder, video)
         clips = self._decode_sync(decoder, video, starts)
         n = clips.shape[0]
         time_card.num_clips = n
@@ -525,7 +544,10 @@ class R2P1DFusingLoader(R2P1DLoader):
         assert rows <= cap, (rows, cap)
         bucket = self._bucket_for(rows)
         with hostprof.section("loader.emit_alloc"):
-            out = np.zeros(self._batch_shape(bucket), dtype=np.uint8)
+            # rows [0, row) are overwritten below; only the padding
+            # tail needs zeroing (a full np.zeros cost 4.3% of the
+            # host core at ~1k videos/s — hostprof, round 5)
+            out = np.empty(self._batch_shape(bucket), dtype=np.uint8)
         cards, row = [], 0
         with hostprof.section("loader.emit_wait+copy"):
             for handle, video, tc, _ in take:
@@ -533,6 +555,8 @@ class R2P1DFusingLoader(R2P1DLoader):
                 out[row:row + handle.n] = handle.out[: handle.n]
                 row += handle.n
                 cards.append(tc)
+            if row < out.shape[0]:
+                out[row:] = 0
         with hostprof.section("loader.device_put"):
             batch = jax.device_put(out, self._jax_device)
         if self._preprocess is not None:

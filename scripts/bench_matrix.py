@@ -161,7 +161,9 @@ def main() -> int:
     cols = ["config", "mi_ms", "videos", "videos/s",
             "poisson p50/p99 ms", "bulk drain p50/p99 s",
             "decode", "clips/s", "tflops", "mfu", "vs_baseline"]
-    default_backend = rows[0].get("decode_backend", "?")
+    default_backend = next(
+        (r["decode_backend"] for r in rows if "decode_backend" in r),
+        "?")  # first SUCCESSFUL row: an errored first cell has no key
     lines = ["# Benchmark matrix",
              "",
              "decode_backend: `%s`  platform: `%s`  device: `%s`" % (
