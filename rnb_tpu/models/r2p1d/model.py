@@ -565,6 +565,31 @@ class R2P1DFusingLoader(R2P1DLoader):
         from rnb_tpu.telemetry import TimeCardList
         return ((PaddedBatch(batch, row),), None, TimeCardList(cards))
 
+    #: harvest-check tick while decodes are in flight but nothing is
+    #: ready: bounds how late a completed decode is noticed
+    HARVEST_TICK_S = 0.005
+
+    def next_deadline_s(self):
+        """Seconds until this stage next needs an idle poll, or None
+        when it holds no work. The executor shrinks its queue-poll
+        timeout to this, so hold-timeout emissions fire ~on time
+        instead of on the next 50 ms poll tick — the round-5 frontier
+        measured that granularity as the light-load p99 floor
+        (57-61 ms at 111 req/s vs the 5-8 ms configured hold)."""
+        self._harvest()  # peek-only: fresh view of completed decodes
+        if self._ready:
+            if not self._inflight:
+                return 0.0  # nothing else can fuse: emit now
+            waited = time.monotonic() - self._ready[0][3]
+            remaining = max(0.0, self.max_hold_ms / 1000.0 - waited)
+            # two triggers race: the hold expiry AND an in-flight
+            # decode completing (which can satisfy the fuse/rows/
+            # nothing-in-flight rules early) — bound by the sooner
+            return min(remaining, self.HARVEST_TICK_S)
+        if self._inflight:
+            return self.HARVEST_TICK_S
+        return None
+
     def poll(self):
         """Idle tick from the executor (no arrival within its queue
         poll window): emit a held batch that has met an emission rule

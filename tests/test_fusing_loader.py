@@ -145,6 +145,39 @@ def test_idle_poll_emits_on_hold_timeout(tmp_path):
     assert loader.flush() is None
 
 
+def test_next_deadline_drives_poll_timeout(tmp_path):
+    """The stage's deadline hook and the executor's timeout clamp: a
+    held batch's hold expiry must shrink the queue-poll window (the
+    round-5 frontier measured the fixed 50 ms poll as the light-load
+    p99 floor)."""
+    import time
+
+    from rnb_tpu.runner import MIN_POLL_S, QUEUE_POLL_S, poll_timeout
+    paths = _dataset(tmp_path, n=3)
+    loader = _loader(fuse=100, max_hold_ms=30.0, depth=100)
+    assert loader.next_deadline_s() is None  # no work held
+    assert poll_timeout(loader) == QUEUE_POLL_S
+    out = loader(None, paths[0], TimeCard(0))
+    if out[2] is None:  # swallowed (the usual case: decode in flight)
+        # decode in flight or already ready: the deadline must be at
+        # most the harvest tick / the remaining hold — far below the
+        # 50 ms poll
+        deadline = loader.next_deadline_s()
+        assert deadline is not None and deadline <= 0.031
+        assert MIN_POLL_S <= poll_timeout(loader) <= 0.031
+        # once the decode lands and the hold expires, the deadline
+        # collapses to zero (generous cap: slow CI host)
+        cap = time.time() + 10
+        while loader.next_deadline_s() != 0.0 and time.time() < cap:
+            time.sleep(0.005)
+        assert loader.next_deadline_s() == 0.0
+        assert poll_timeout(loader) == MIN_POLL_S
+        assert loader.poll() is not None  # and the poll emits
+    assert loader.next_deadline_s() is None
+    # stages without the hook keep the coarse default
+    assert poll_timeout(object()) == QUEUE_POLL_S
+
+
 def test_discard_pending_retires_all_tickets(tmp_path):
     """Abort path: every submitted decode (in flight AND harvested but
     unemitted) must be retired so the shared pool pins no buffers."""
