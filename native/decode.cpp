@@ -284,50 +284,73 @@ inline int Extend(int v, int s) {
   return (s && v < (1 << (s - 1))) ? v - (1 << s) + 1 : v;
 }
 
-// k[u][x] = 0.5 * alpha(u) * cos((2x+1) u pi / 16); DC-only block
-// collapses to F00/8.
-struct IdctTable {
-  float k[8][8];
-  IdctTable() {
-    for (int u = 0; u < 8; ++u)
-      for (int x = 0; x < 8; ++x)
-        k[u][x] = 0.5f * (u == 0 ? 0.70710678f : 1.0f) *
-                  std::cos(float((2 * x + 1)) * u * 3.14159265358979f / 16.0f);
-  }
-};
+// AAN per-coefficient scale factors s[k] = sqrt(2) cos(k pi/16)
+// (s[0] = 1), folded into the dequant tables together with the /8
+// normalization so the per-block transform needs only 5 multiplies
+// per 1-D pass instead of a full 8x8 matrix product.
+constexpr float kAanScale[8] = {
+    1.0f, 1.387039845f, 1.306562965f, 1.175875602f,
+    1.0f, 0.785694958f, 0.541196100f, 0.275899379f};
 
-// row_mask: bit v set when coefficient row v has any nonzero entry —
-// zero rows contribute nothing to either pass, and most blocks at
-// typical qualities populate only the first few rows. Inner loops are
-// fixed 8-wide with no branches so the compiler can vectorize them;
 // FMA contraction is re-enabled here (the file-level -ffp-contract=off
 // exists for the y4m RGB conversion's bit-exact numpy parity, which
 // the IDCT does not participate in).
 #pragma GCC push_options
 #pragma GCC optimize("fp-contract=fast")
+
+// One 1-D pass of the AAN inverse (Arai–Agui–Nakajima scaled IDCT):
+// inputs are coefficients pre-scaled by kAanScale[u]*kAanScale[v]/8.
+// Butterfly validated against the direct cosine-matrix IDCT to float
+// precision (see the numpy derivation in tests/test_mjpeg.py history).
+inline void AanIdct1D(const float* in, int is, float* out, int os) {
+  const float x0 = in[0], x1 = in[1 * is], x2 = in[2 * is],
+              x3 = in[3 * is], x4 = in[4 * is], x5 = in[5 * is],
+              x6 = in[6 * is], x7 = in[7 * is];
+  const float p0 = x0 + x4, p1 = x0 - x4;
+  const float p2 = x2 + x6;
+  const float p3 = (x2 - x6) * 1.414213562f - p2;
+  const float e0 = p0 + p2, e3 = p0 - p2;
+  const float e1 = p1 + p3, e2 = p1 - p3;
+  const float z13 = x5 + x3, z10 = x5 - x3;
+  const float z11 = x1 + x7, z12 = x1 - x7;
+  const float t7 = z11 + z13;
+  const float t11 = (z11 - z13) * 1.414213562f;
+  const float z5 = (z10 + z12) * 1.847759065f;
+  const float t10 = 1.082392200f * z12 - z5;
+  const float t12 = -2.613125930f * z10 + z5;
+  const float t6 = t12 - t7;
+  const float t5 = t11 - t6;
+  const float t4 = t10 + t5;
+  out[0] = e0 + t7;
+  out[7 * os] = e0 - t7;
+  out[1 * os] = e1 + t6;
+  out[6 * os] = e1 - t6;
+  out[2 * os] = e2 + t5;
+  out[5 * os] = e2 - t5;
+  out[4 * os] = e3 + t4;
+  out[3 * os] = e3 - t4;
+}
+
+// row_mask: bit v set when coefficient row v has any nonzero entry —
+// zero rows produce zero intermediate rows and skip their pass-1
+// butterfly (most blocks at typical qualities populate only the
+// first few rows).
 void Idct8x8(const float* blk, int row_mask, unsigned char* out,
              int out_stride) {
-  static const IdctTable tab;
-  float tmp[64];  // tmp[v][x] = sum_u k[u][x] * blk[v*8+u]
-  float accum[64] = {0.f};  // accum[y][x]
+  float tmp[64];
   for (int v = 0; v < 8; ++v) {
-    if (!(row_mask & (1 << v))) continue;
-    const float* row = blk + v * 8;
-    float* trow = tmp + v * 8;
-    for (int x = 0; x < 8; ++x) {
-      float s = 0.f;
-      for (int u = 0; u < 8; ++u) s += tab.k[u][x] * row[u];
-      trow[x] = s;
+    if (!(row_mask & (1 << v))) {
+      std::memset(tmp + v * 8, 0, 8 * sizeof(float));
+      continue;
     }
-    for (int y = 0; y < 8; ++y) {
-      const float kv = tab.k[v][y];
-      float* arow = accum + y * 8;
-      for (int x = 0; x < 8; ++x) arow[x] += kv * trow[x];
-    }
+    AanIdct1D(blk + v * 8, 1, tmp + v * 8, 1);
   }
+  float cols[64];  // cols[y][x]
+  for (int x = 0; x < 8; ++x)
+    AanIdct1D(tmp + x, 8, cols + x, 8);
   for (int y = 0; y < 8; ++y) {
     unsigned char* orow = out + y * out_stride;
-    const float* arow = accum + y * 8;
+    const float* arow = cols + y * 8;
     for (int x = 0; x < 8; ++x) {
       const float px = arow[x] + 128.0f;
       orow[x] = ClipByte(px < 0.f ? 0.f : (px + 0.5f));  // round half up
@@ -415,6 +438,9 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
           comps[c].h = seg[7 + c * 3] >> 4;
           comps[c].v = seg[7 + c * 3] & 15;
           comps[c].tq = seg[8 + c * 3];
+          // Tq indexes qt[4]/fq[4]: an unvalidated byte here would be
+          // an out-of-bounds indexed WRITE when fq is built
+          if (comps[c].tq > 3) return kErrFormat;
         }
         break;
       }
@@ -432,10 +458,14 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
           return kErrFormat;
         for (int s = 0; s < ns; ++s) {
           const int cs = seg[1 + s * 2];
+          const int td = seg[2 + s * 2] >> 4;
+          const int ta = seg[2 + s * 2] & 15;
+          // Td/Ta index hdc[4]/hac[4]
+          if (td > 3 || ta > 3) return kErrFormat;
           for (int c = 0; c < ncomp; ++c)
             if (comps[c].id == cs) {
-              comps[c].td = seg[2 + s * 2] >> 4;
-              comps[c].ta = seg[2 + s * 2] & 15;
+              comps[c].td = td;
+              comps[c].ta = ta;
             }
         }
         sos = true;
@@ -463,6 +493,17 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
   const int maxh = comps[0].h, maxv = comps[0].v;
   const int mcus_x = (w + 8 * maxh - 1) / (8 * maxh);
   const int mcus_y = (h + 8 * maxv - 1) / (8 * maxv);
+  // dequant tables with the AAN scale factors and /8 normalization
+  // folded in (indexed in zigzag scan order like the raw tables)
+  float fq[4][64];
+  for (int c = 0; c < ncomp; ++c) {
+    const int tq_id = comps[c].tq;
+    for (int k = 0; k < 64; ++k) {
+      const int nat = kZigzag[k];
+      fq[tq_id][k] = static_cast<float>(qt[tq_id][k]) *
+                     kAanScale[nat >> 3] * kAanScale[nat & 7] / 8.0f;
+    }
+  }
   for (int c = 0; c < ncomp; ++c) {
     if (!qt_ok[comps[c].tq] || !hdc[comps[c].td].present ||
         !hac[comps[c].ta].present)
@@ -486,7 +527,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
       if (restart_interval) --mcus_until_restart;
       for (int c = 0; c < ncomp; ++c) {
         JpegComponent& comp = comps[c];
-        const unsigned short* q = qt[comp.tq];
+        const float* q = fq[comp.tq];
         for (int by = 0; by < comp.v; ++by) {
           for (int bx = 0; bx < comp.h; ++bx) {
             // entropy-decode one block
@@ -495,7 +536,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
             const int diff = Extend(br.GetBits(t), t);
             dc_pred[c] += diff;
             std::memset(blk, 0, sizeof(blk));
-            blk[0] = static_cast<float>(dc_pred[c] * q[0]);
+            blk[0] = static_cast<float>(dc_pred[c]) * q[0];
             int k = 1, row_mask = 1;
             bool ac_any = false;
             const HuffTable& act = hac[comp.ta];
@@ -519,7 +560,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                   br.Drop(hlen + s_);
                   const int nat = kZigzag[k];
                   blk[nat] =
-                      static_cast<float>(Extend(vraw, s_) * q[k]);
+                      static_cast<float>(Extend(vraw, s_)) * q[k];
                   row_mask |= 1 << (nat >> 3);
                   ac_any = true;
                   ++k;
@@ -535,7 +576,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                   if (k > 63) return kErrFormat;
                   const int nat = kZigzag[k];
                   blk[nat] = static_cast<float>(
-                      Extend(br.GetBits(s_), s_) * q[k]);
+                      Extend(br.GetBits(s_), s_)) * q[k];
                   row_mask |= 1 << (nat >> 3);
                   ac_any = true;
                   ++k;
@@ -555,7 +596,8 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                 static_cast<size_t>(py) * comp.plane_w + px;
             if (!ac_any) {
               // DC-only block: the IDCT collapses to a flat fill
-              const float px0 = blk[0] * 0.125f + 128.0f;
+              // the folded dequant already carries the /8
+              const float px0 = blk[0] + 128.0f;
               const unsigned char flat =
                   ClipByte(px0 < 0.f ? 0.f : (px0 + 0.5f));
               for (int ry = 0; ry < 8; ++ry)
