@@ -493,17 +493,6 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
   const int maxh = comps[0].h, maxv = comps[0].v;
   const int mcus_x = (w + 8 * maxh - 1) / (8 * maxh);
   const int mcus_y = (h + 8 * maxv - 1) / (8 * maxv);
-  // dequant tables with the AAN scale factors and /8 normalization
-  // folded in (indexed in zigzag scan order like the raw tables)
-  float fq[4][64];
-  for (int c = 0; c < ncomp; ++c) {
-    const int tq_id = comps[c].tq;
-    for (int k = 0; k < 64; ++k) {
-      const int nat = kZigzag[k];
-      fq[tq_id][k] = static_cast<float>(qt[tq_id][k]) *
-                     kAanScale[nat >> 3] * kAanScale[nat & 7] / 8.0f;
-    }
-  }
   for (int c = 0; c < ncomp; ++c) {
     if (!qt_ok[comps[c].tq] || !hdc[comps[c].td].present ||
         !hac[comps[c].ta].present)
@@ -512,6 +501,19 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
     comps[c].plane_h = mcus_y * comps[c].v * 8;
     comps[c].plane.assign(
         static_cast<size_t>(comps[c].plane_w) * comps[c].plane_h, 0);
+  }
+  // dequant tables with the AAN scale factors and /8 normalization
+  // folded in (indexed in zigzag scan order like the raw tables);
+  // built AFTER the qt_ok validation so an undefined table never
+  // feeds the fold
+  float fq[4][64];
+  for (int c = 0; c < ncomp; ++c) {
+    const int tq_id = comps[c].tq;
+    for (int k = 0; k < 64; ++k) {
+      const int nat = kZigzag[k];
+      fq[tq_id][k] = static_cast<float>(qt[tq_id][k]) *
+                     kAanScale[nat >> 3] * kAanScale[nat & 7] / 8.0f;
+    }
   }
   BitReader br(data + scan_start, n - scan_start);
   int dc_pred[3] = {0, 0, 0};

@@ -429,20 +429,28 @@ class MjpegPILDecoder(VideoDecoder):
         packed = height * width * 3 // 2
         out = np.empty((len(clip_starts), consecutive_frames, packed),
                        dtype=np.uint8)
+        maps = None  # index maps are geometry-invariant: built once
         for ci, start in enumerate(clip_starts):
             for fi in range(consecutive_frames):
                 off, length = frames[min(start + fi, count - 1)]
                 with Image.open(io.BytesIO(data[off:off + length])) as im:
                     im.draft("YCbCr", im.size)
                     ycc = np.asarray(im.convert("YCbCr"))
-                h, w = ycc.shape[:2]
-                rows = np.arange(height) * h // height
-                cols = np.arange(width) * w // width
-                crows = np.arange(height // 2) * (h // 2) // (height // 2)
-                ccols = np.arange(width // 2) * (w // 2) // (width // 2)
+                if maps is None or maps[0] != ycc.shape[:2]:
+                    # maps are per-geometry; frames from external
+                    # encoders may legally vary in size mid-file
+                    h, w = ycc.shape[:2]
+                    maps = ((h, w),
+                            np.arange(height) * h // height,
+                            np.arange(width) * w // width,
+                            np.arange(height // 2) * (h // 2)
+                            // (height // 2) * 2,
+                            np.arange(width // 2) * (w // 2)
+                            // (width // 2) * 2)
+                _geom, rows, cols, crows, ccols = maps
                 y = ycc[rows][:, cols, 0]
-                u = ycc[crows * 2][:, ccols * 2, 1]
-                v = ycc[crows * 2][:, ccols * 2, 2]
+                u = ycc[crows][:, ccols, 1]
+                v = ycc[crows][:, ccols, 2]
                 out[ci, fi] = np.concatenate(
                     [y.ravel(), u.ravel(), v.ravel()])
         return out

@@ -30,28 +30,10 @@ with open("BENCH_ATTEMPTS.jsonl", "a") as f:
     f.write(json.dumps({"ts": ts, "attempt": None, "rc": rc,
                         "source": "auto-headline-loop",
                         "result": result}) + "\n")
-if (rc == 0 and isinstance(result, dict)
-        and result.get("platform") == "tpu"
-        and isinstance(result.get("value"), (int, float))
-        and result["value"]):
-    # read-modify-write under an exclusive lock (concurrent capture
-    # loops race here), committed via rename so readers never see a
-    # torn file
-    import fcntl, os
-    with open("BENCH_TPU.json.lock", "w") as lock:
-        fcntl.flock(lock, fcntl.LOCK_EX)
-        try:
-            best = json.load(open("BENCH_TPU.json")).get("value") or 0
-        except Exception:
-            best = 0
-        if result["value"] > best:
-            tmp = "BENCH_TPU.json.tmp"
-            with open(tmp, "w") as f:
-                f.write(json.dumps(result) + "\n")
-            os.replace(tmp, "BENCH_TPU.json")
-            print("headline loop: new best %.1f (was %.1f)"
-                  % (result["value"], best), file=sys.stderr)
 EOF
+  if [ "$rc" -eq 0 ] && grep -q '"platform": "tpu"' "$OUT" 2>/dev/null; then
+    python scripts/keep_best.py "$OUT" || true
+  fi
   echo "headline loop: attempt $i rc=$rc; sleeping ${SLEEP_S}s" >&2
   sleep "$SLEEP_S"
 done

@@ -1,0 +1,39 @@
+"""Keep-best update of BENCH_TPU.json under the shared lock.
+
+    python scripts/keep_best.py <attempt.json>
+
+Reads one bench.py result line from the file, and — holding
+BENCH_TPU.json.lock — replaces BENCH_TPU.json via rename iff the new
+value beats the recorded best. Exits 1 when the attempt carries no
+numeric value (so capture loops cannot count a bogus line as
+success). Shared by headline_loop.sh, tpu_bench_loop.sh and manual
+captures; concurrent writers serialize on the flock.
+"""
+
+import fcntl
+import json
+import os
+import sys
+
+
+def main() -> int:
+    result = json.load(open(sys.argv[1]))
+    if not isinstance(result.get("value"), (int, float)):
+        return 1
+    with open("BENCH_TPU.json.lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            best = json.load(open("BENCH_TPU.json")).get("value") or 0
+        except Exception:
+            best = 0
+        if result["value"] > best:
+            with open("BENCH_TPU.json.tmp", "w") as f:
+                f.write(json.dumps(result) + "\n")
+            os.replace("BENCH_TPU.json.tmp", "BENCH_TPU.json")
+            print("keep_best: new best %.1f (was %.1f)"
+                  % (result["value"], best), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

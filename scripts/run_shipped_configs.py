@@ -34,11 +34,10 @@ def main():
                         help="basename glob to restrict the sweep")
     args = parser.parse_args()
 
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    if "host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-        os.environ["XLA_FLAGS"] += \
-            " --xla_force_host_platform_device_count=8"
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")  # beat the axon site hook
     os.environ.setdefault("RNB_TPU_DATA_ROOT",

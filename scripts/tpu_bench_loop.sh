@@ -23,27 +23,7 @@ for i in $(seq 1 "$MAX_ATTEMPTS"); do
   printf '{"ts": "%s", "attempt": %d, "rc": %d, "result": %s}\n' \
     "$ts" "$i" "$rc" "$line" >> BENCH_ATTEMPTS.jsonl
   if [ "$rc" -eq 0 ] && printf '%s' "$line" | grep -q '"platform": "tpu"'; then
-    # keep-best under the shared lock (headline_loop.sh and manual
-    # captures write the same file), committed via rename; exits
-    # nonzero when the capture carries no numeric value so a bogus
-    # line cannot count as success
-    if python - /tmp/bench_attempt.json <<'EOF'
-import fcntl, json, os, sys
-result = json.load(open(sys.argv[1]))
-if not isinstance(result.get("value"), (int, float)):
-    sys.exit(1)
-with open("BENCH_TPU.json.lock", "w") as lock:
-    fcntl.flock(lock, fcntl.LOCK_EX)
-    try:
-        best = json.load(open("BENCH_TPU.json")).get("value") or 0
-    except Exception:
-        best = 0
-    if result["value"] > best:
-        with open("BENCH_TPU.json.tmp", "w") as f:
-            f.write(json.dumps(result) + "\n")
-        os.replace("BENCH_TPU.json.tmp", "BENCH_TPU.json")
-EOF
-    then
+    if python scripts/keep_best.py /tmp/bench_attempt.json; then
       echo "bench loop: TPU capture succeeded on attempt $i" >&2
       exit 0
     else
