@@ -236,6 +236,30 @@ def test_bench_matrix_unparseable_cell_is_contained(monkeypatch,
     assert "unparseable" in row["error"]
 
 
+def test_keep_best_locked_update(tmp_path, monkeypatch):
+    """scripts/keep_best.py: best-by-value replacement under the lock,
+    nonzero exit for value-less captures (both capture loops rely on
+    that contract)."""
+    import json as _json
+    import subprocess as _sp
+
+    monkeypatch.chdir(tmp_path)
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "keep_best.py")
+    (tmp_path / "BENCH_TPU.json").write_text('{"value": 500}\n')
+    att = tmp_path / "att.json"
+    att.write_text('{"value": 400, "platform": "tpu"}')
+    assert _sp.run([sys.executable, script, str(att)]).returncode == 0
+    assert _json.loads((tmp_path / "BENCH_TPU.json").read_text())[
+        "value"] == 500  # lower value: kept the old best
+    att.write_text('{"value": 900, "platform": "tpu"}')
+    assert _sp.run([sys.executable, script, str(att)]).returncode == 0
+    assert _json.loads((tmp_path / "BENCH_TPU.json").read_text())[
+        "value"] == 900
+    att.write_text('{"value": null}')
+    assert _sp.run([sys.executable, script, str(att)]).returncode == 1
+
+
 def test_device_busy_union_and_filter(tmp_path):
     import device_busy
 
