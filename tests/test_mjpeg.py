@@ -220,6 +220,37 @@ def test_unsupported_jpegs_fail_cleanly(tmp_path):
         nd.decode_clips(pprog, [0], 1, width=W, height=H)
 
 
+@needs_native
+def test_restart_markers_decode_and_scan(tmp_path):
+    """DRI/RSTn streams: the decoder must resynchronize at restart
+    intervals (byte-align, reset DC predictors) and the scanner must
+    step over in-entropy RST markers — luma still matches libjpeg."""
+    from PIL import Image
+    frames = synth_frames(2, H, W, seed=[6, 6, 6])
+    path = str(tmp_path / "rst.mjpg")
+    with open(path, "wb") as f:
+        for i in range(2):
+            buf = io.BytesIO()
+            Image.fromarray(frames[i], "RGB").save(
+                buf, "JPEG", quality=90, subsampling=2,
+                restart_marker_blocks=4)
+            b = buf.getvalue()
+            assert b"\xff\xdd" in b  # DRI present
+            f.write(b)
+    with open(path, "rb") as f:
+        data = f.read()
+    assert sum(data.count(bytes([0xFF, 0xD0 + i]))
+               for i in range(8)) >= 2  # real RSTs in the streams
+    assert len(scan_mjpeg_frames(data)) == 2
+    nd = NativeY4MDecoder()
+    assert nd.num_frames(path) == 2
+    for idx in (0, 1):
+        out = nd.decode_clips_yuv(path, [idx], 1, width=W, height=H)
+        y_native = out[0, 0][:H * W].reshape(H, W).astype(int)
+        y_pil = _pil_ycbcr(path, idx)[..., 0].astype(int)
+        assert np.abs(y_native - y_pil).max() <= 2
+
+
 def test_app_segment_with_embedded_eoi_not_split(tmp_path):
     """An APPn payload may legally contain FF D9 (e.g. an EXIF
     thumbnail's end-of-image); the scanner must skip segments by their
