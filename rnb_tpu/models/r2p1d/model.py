@@ -36,6 +36,7 @@ from rnb_tpu.models.r2p1d.sampler import R2P1DSampler
 from rnb_tpu.selector import QueueSelector
 from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
 from rnb_tpu.telemetry import TimeCard
+from rnb_tpu import video_path_provider
 from rnb_tpu.video_path_provider import VideoPathIterator
 
 MAX_CLIPS = 15
@@ -1035,7 +1036,13 @@ class R2P1DVideoPathIterator(VideoPathIterator):
     resolves procedurally.
     """
 
-    EXTENSIONS = (".y4m", ".mjpg", ".mjpeg")
+    EXTENSIONS = video_path_provider.VIDEO_EXTENSIONS
+
+    @classmethod
+    def scan_tree(cls, root: str) -> list:
+        """Sorted video paths from a root/label/video tree; delegates
+        to the jax-free scan in rnb_tpu.video_path_provider."""
+        return video_path_provider.scan_video_tree(root, cls.EXTENSIONS)
 
     def __init__(self, root: Optional[str] = None,
                  num_synthetic: int = 200):
@@ -1043,15 +1050,8 @@ class R2P1DVideoPathIterator(VideoPathIterator):
         import itertools
         import os
         root = root or os.environ.get("RNB_TPU_DATA_ROOT")
-        videos = []
-        if root and os.path.isdir(root):
-            for label in sorted(os.listdir(root)):
-                label_dir = os.path.join(root, label)
-                if os.path.isdir(label_dir):
-                    videos.extend(
-                        os.path.join(label_dir, v)
-                        for v in sorted(os.listdir(label_dir))
-                        if v.endswith(self.EXTENSIONS))
+        videos = (self.scan_tree(root)
+                  if root and os.path.isdir(root) else [])
         if not videos:
             videos = ["synth://kinetics/video-%04d" % i
                       for i in range(num_synthetic)]

@@ -10,6 +10,28 @@ Reference parity: video_path_provider.py:1-14.
 
 from __future__ import annotations
 
+import os
+
+VIDEO_EXTENSIONS = (".y4m", ".mjpg", ".mjpeg")
+
+
+def scan_video_tree(root: str, extensions=VIDEO_EXTENSIONS) -> list:
+    """Sorted video paths from a root/label/video dataset tree (the
+    reference's Kinetics layout, models/r2p1d/model.py:86-113). The
+    one dataset-layout scan — the r2p1d iterator and
+    scripts/decode_bench.py both delegate here; it lives in this
+    jax-free module so tooling can scan datasets without importing
+    the model stack."""
+    videos = []
+    for label in sorted(os.listdir(root)):
+        label_dir = os.path.join(root, label)
+        if os.path.isdir(label_dir):
+            videos.extend(
+                os.path.join(label_dir, v)
+                for v in sorted(os.listdir(label_dir))
+                if v.endswith(extensions))
+    return videos
+
 
 class VideoPathIterator:
     """Base contract: iterate video paths (or synthetic video ids) forever."""

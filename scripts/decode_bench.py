@@ -1,0 +1,82 @@
+"""Single-caller decode micro-benchmark for the native backend.
+
+Measures raw frames/s of the C++ decoder (native/decode.cpp) outside
+the pipeline — the number RESULTS.md quotes when attributing matrix-
+cell throughput to the host codec (the role NVDEC benchmarks filled
+for the reference's NVVL loader, reference README.md:42-110). Decodes
+every video in a dataset tree sequentially on the calling thread (no
+pool fan-out) so the figure is per-core codec speed, not concurrency.
+
+Usage::
+
+    python scripts/decode_bench.py data/bench_mjpeg [--pixfmt yuv420]
+        [--repeats 3]
+
+Prints one JSON line: {"frames_per_sec": N, "videos": N, "frames": N,
+"wall_s": N, "pixfmt": "...", "dataset": "..."}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from rnb_tpu.decode.native import NativeY4MDecoder  # noqa: E402
+from rnb_tpu.video_path_provider import (  # noqa: E402
+    VIDEO_EXTENSIONS, scan_video_tree)
+
+
+def dataset_videos(root: str):
+    vids = scan_video_tree(root)
+    if not vids:
+        raise SystemExit("no %s videos under %s"
+                         % (VIDEO_EXTENSIONS, root))
+    return vids
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dataset")
+    ap.add_argument("--pixfmt", choices=("rgb", "yuv420"),
+                    default="yuv420")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="best-of-N passes over the dataset")
+    ap.add_argument("--consecutive-frames", type=int, default=8)
+    args = ap.parse_args()
+
+    dec = NativeY4MDecoder(use_pool=False)  # single-caller by design
+    videos = dataset_videos(args.dataset)
+    cf = args.consecutive_frames
+    plans = []  # (video, clip_starts) decoding every frame exactly once
+    total_frames = 0
+    for v in videos:
+        n = dec.num_frames(v)
+        starts = list(range(0, n - cf + 1, cf))
+        plans.append((v, starts))
+        total_frames += len(starts) * cf
+
+    decode = (dec.decode_clips if args.pixfmt == "rgb"
+              else dec.decode_clips_yuv)
+    best = float("inf")
+    for _ in range(max(1, args.repeats)):
+        t0 = time.perf_counter()
+        for v, starts in plans:
+            decode(v, starts, cf)
+        best = min(best, time.perf_counter() - t0)
+
+    print(json.dumps({
+        "frames_per_sec": round(total_frames / best, 1),
+        "videos": len(videos), "frames": total_frames,
+        "wall_s": round(best, 3), "pixfmt": args.pixfmt,
+        "dataset": args.dataset}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
