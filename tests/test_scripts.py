@@ -447,3 +447,41 @@ def test_device_busy_headerless_four_col_sniffed(tmp_path, capsys):
                      "0 100 /device:TPU:0 fusion.1\n")
     planes = device_busy.load_intervals(str(trace), device_only=False)
     assert set(planes) == {"/device:TPU:0"}
+
+
+def test_decode_bench_smoke(tmp_path):
+    """scripts/decode_bench.py: decodes a tiny dataset tree with the
+    native backend and reports a frame count matching every frame
+    decoded exactly once (the micro-benchmark behind the frames/s
+    rates quoted in MATRIX.md/RESULTS.md)."""
+    import json as _json
+    import subprocess as _sp
+
+    import numpy as np
+
+    from rnb_tpu.decode import write_mjpeg, write_y4m
+    from rnb_tpu.decode.native import native_available
+    if not native_available():
+        pytest.skip("native decode library not built")
+
+    rng = np.random.default_rng(7)
+    frames = rng.integers(0, 255, size=(17, 32, 48, 3), dtype=np.uint8)
+    label = tmp_path / "label000"
+    label.mkdir()
+    write_mjpeg(str(label / "a.mjpg"), frames)
+    write_y4m(str(label / "b.y4m"), frames)
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "decode_bench.py")
+    proc = _sp.run([sys.executable, script, str(tmp_path),
+                    "--repeats", "1"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    row = _json.loads(proc.stdout.strip().splitlines()[-1])
+    # 17 frames, 8-frame clips -> 2 whole clips = 16 frames per video
+    assert row["videos"] == 2
+    assert row["frames"] == 32
+    assert row["frames_per_sec"] > 0
+    # an empty tree must fail loudly, not report 0-frame success
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _sp.run([sys.executable, script, str(empty)],
+                   capture_output=True).returncode != 0
