@@ -205,6 +205,26 @@ struct BitReader {
       : d(data), n(len), pos(0) {}
 
   void Fill() {
+    // fast path: when the next 8 bytes hold no 0xFF (no stuffing, no
+    // marker — the overwhelmingly common case mid-scan), append all
+    // the bytes that fit in one shift instead of branching per byte
+    const int want = (64 - count) >> 3;
+    if (want > 0 && pos + 8 <= n) {
+      unsigned long long v;
+      std::memcpy(&v, d + pos, 8);
+      const unsigned long long m = ~v;  // 0xFF bytes of v become 0x00
+      if (!((m - 0x0101010101010101ull) & ~m & 0x8080808080808080ull)) {
+#if !defined(__BYTE_ORDER__) || __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+        v = __builtin_bswap64(v);  // byte 0 first -> MSB first
+#endif  // big-endian memcpy already has byte 0 in the MSB
+        // want == 8 only when count == 0: plain assign (acc << 64 is UB)
+        acc = want == 8 ? v
+                        : (acc << (want * 8)) | (v >> (64 - want * 8));
+        pos += want;
+        count += want * 8;
+        return;
+      }
+    }
     while (count <= 56) {
       unsigned char b;
       if (pos >= n) {
