@@ -27,3 +27,5 @@ from rnb_tpu.telemetry import TimeCard, TimeCardList, TimeCardSummary
 from rnb_tpu.stage import PaddedBatch, StageModel
 from rnb_tpu.selector import QueueSelector, RoundRobinSelector
 from rnb_tpu.video_path_provider import VideoPathIterator
+from rnb_tpu.faults import (CorruptVideoError, FaultPlan, PermanentError,
+                            TransientError, classify_error)

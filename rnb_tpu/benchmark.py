@@ -25,7 +25,7 @@ import shutil
 import sys
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Dict, Optional
 
@@ -72,6 +72,17 @@ class BenchmarkResult:
     #: pool) over the measured window; / total_time_s ~ host-core
     #: saturation on a 1-core host
     host_cpu_s: float = 0.0
+    #: fault-containment accounting (rnb_tpu.faults): requests
+    #: dead-lettered with a permanent failure, dropped by the "shed"
+    #: overload policy, and transient retry attempts. Successfully
+    #: completed requests = num_completed; throughput_vps and the
+    #: latency percentiles cover successes only.
+    num_completed: int = 0
+    num_failed: int = 0
+    num_shed: int = 0
+    num_retries: int = 0
+    failure_reasons: Dict[str, int] = field(default_factory=dict)
+    shed_sites: Dict[str, int] = field(default_factory=dict)
 
 
 def run_benchmark(config_path: str,
@@ -94,8 +105,9 @@ def run_benchmark(config_path: str,
     maybe_initialize()
     from rnb_tpu.client import bulk_client, poisson_client
     from rnb_tpu.config import load_config
-    from rnb_tpu.control import (ChannelFabric, InferenceCounter,
-                                 TerminationState)
+    from rnb_tpu.control import (ChannelFabric, FaultStats,
+                                 InferenceCounter, TerminationState)
+    from rnb_tpu.faults import FaultPlan
     from rnb_tpu.runner import RunnerContext, runner
     from rnb_tpu.telemetry import logmeta, logroot
 
@@ -119,6 +131,14 @@ def run_benchmark(config_path: str,
     counter = InferenceCounter()
     termination = TerminationState()
     summary_sink: list = []
+    fault_stats = FaultStats()
+    fault_plan = FaultPlan.resolve(config.fault_plan)
+    if fault_plan is not None:
+        # env-provided plans bypass config parsing — re-check their
+        # step indices against this pipeline before launching
+        fault_plan.check_steps(config.num_steps)
+    if fault_plan is not None and print_progress:
+        print("[rnb-tpu] fault plan active: %s" % fault_plan.describe())
 
     # bulk mode pre-enqueues everything; size the queues accordingly
     # (reference benchmark.py:209 — but unlike the reference, account
@@ -139,6 +159,9 @@ def run_benchmark(config_path: str,
     fabric = ChannelFabric(config, effective_queue_size)
 
     threads = []
+    client_kwargs = dict(overload_policy=config.overload_policy,
+                         fault_stats=fault_stats, counter=counter,
+                         target_num_videos=num_videos)
     if mean_interval_ms > 0:
         client_args = (config.video_path_iterator,
                        fabric.get_filename_queue(), mean_interval_ms,
@@ -152,6 +175,7 @@ def run_benchmark(config_path: str,
                        fabric.filename_num_markers)
         client_impl = bulk_client
     threads.append(threading.Thread(target=client_impl, args=client_args,
+                                    kwargs=client_kwargs,
                                     name="client", daemon=True))
 
     for step_idx, step in enumerate(config.steps):
@@ -189,6 +213,12 @@ def run_benchmark(config_path: str,
                     log_base=log_base,
                     model_kwargs=model_kwargs,
                     summary_sink=summary_sink if is_final else None,
+                    containment=config.fault_containment,
+                    overload_policy=config.overload_policy,
+                    max_retries=step.max_retries,
+                    retry_backoff_ms=step.retry_backoff_ms,
+                    fault_plan=fault_plan,
+                    fault_stats=fault_stats,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -300,6 +330,14 @@ def run_benchmark(config_path: str,
     for t in threads:
         t.join(timeout=60)
 
+    faults = fault_stats.snapshot()
+    num_failed = faults["num_failed"]
+    num_shed = faults["num_shed"]
+    num_retries = faults["num_retries"]
+    # every disposal (success, contained failure, shed) lands in the
+    # shared counter; successes are what remains
+    num_completed = max(0, counter.value - num_failed - num_shed)
+
     args_repr = ("Namespace(mean_interval_ms=%d, batch_size=%d, videos=%d, "
                  "queue_size=%d, config_file_path=%r)"
                  % (mean_interval_ms, batch_size, num_videos, queue_size,
@@ -308,6 +346,24 @@ def run_benchmark(config_path: str,
         f.write("Args: %s\n" % args_repr)
         f.write("%f %f\n" % (time_start, time_end))
         f.write("Termination flag: %d\n" % termination.value)
+        f.write("Faults: num_failed=%d num_shed=%d num_retries=%d\n"
+                % (num_failed, num_shed, num_retries))
+        if faults["failure_reasons"]:
+            f.write("Failure reasons: %s\n"
+                    % json.dumps(faults["failure_reasons"],
+                                 sort_keys=True))
+        if faults["shed_sites"]:
+            f.write("Shed sites: %s\n"
+                    % json.dumps(faults["shed_sites"], sort_keys=True))
+    if faults["dead_letters"]:
+        # the controller's dead-letter record: one line per contained
+        # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
+        # counters above stay exact regardless)
+        with open(os.path.join(logroot(job_id, base=log_base),
+                               "failed-requests.txt"), "w") as f:
+            f.write("# request_id step reason\n")
+            for rid, step_idx, reason in faults["dead_letters"]:
+                f.write("%s %d %s\n" % (rid, step_idx, reason))
     shutil.copyfile(config_path,
                     os.path.join(logroot(job_id, base=log_base),
                                  os.path.basename(config_path)))
@@ -325,7 +381,12 @@ def run_benchmark(config_path: str,
     p50, p99 = pct.get(50.0), pct.get(99.0)
     if pct and print_progress:
         print("Latency p50: %.3f ms  p99: %.3f ms (%d steady-state "
-              "records)" % (p50, p99, len(latencies)))
+              "records, successes only)" % (p50, p99, len(latencies)))
+    if (num_failed or num_shed or num_retries) and print_progress:
+        print("Faults: %d failed, %d shed, %d retries (%s)"
+              % (num_failed, num_shed, num_retries,
+                 ", ".join("%s=%d" % kv for kv in sorted(
+                     faults["failure_reasons"].items())) or "-"))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -343,13 +404,22 @@ def run_benchmark(config_path: str,
         total_time_s=total_time,
         num_videos=num_videos,
         termination_flag=int(termination.value),
-        throughput_vps=(counter.value / total_time if total_time > 0
+        # successes only: shed/failed requests must not inflate the
+        # headline rate (success-rate and shed-rate are first-class
+        # metrics next to it)
+        throughput_vps=(num_completed / total_time if total_time > 0
                         else 0.0),
         log_dir=logroot(job_id, base=log_base),
         p50_latency_ms=p50,
         p99_latency_ms=p99,
         clips_completed=clips_completed,
         host_cpu_s=host_cpu_s,
+        num_completed=num_completed,
+        num_failed=num_failed,
+        num_shed=num_shed,
+        num_retries=num_retries,
+        failure_reasons=dict(faults["failure_reasons"]),
+        shed_sites=dict(faults["shed_sites"]),
     )
 
 
@@ -393,6 +463,24 @@ def main(argv=None) -> int:
         import flax  # noqa: F401
         from rnb_tpu import control, runner, client  # noqa: F401
         from rnb_tpu.models.r2p1d import model  # noqa: F401
+        # validate the named config against the full (extended) schema
+        # and surface its robustness posture — the knobs an operator
+        # needs to know before pointing traffic at the pipeline
+        from rnb_tpu.config import load_config
+        from rnb_tpu.faults import FaultPlan
+        cfg = load_config(args.config_file_path)
+        retries = ", ".join(
+            "step%d: %d@%gms" % (i, s.max_retries, s.retry_backoff_ms)
+            for i, s in enumerate(cfg.steps) if s.max_retries) or "none"
+        print("config %s: %d step(s), overload_policy=%s, "
+              "fault_containment=%s, retries: %s"
+              % (args.config_file_path, cfg.num_steps,
+                 cfg.overload_policy, cfg.fault_containment, retries))
+        plan = FaultPlan.resolve(cfg.fault_plan)
+        if plan is not None:
+            plan.check_steps(cfg.num_steps)
+        print("fault plan: %s"
+              % (plan.describe() if plan is not None else "none"))
         print("rnb_tpu is ready to go!")
         return 0
 

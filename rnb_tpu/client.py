@@ -4,8 +4,14 @@
 inter-arrival time (mean ``mean_interval_ms``) — the open-loop streaming
 workload. ``bulk_client`` enqueues ``num_videos`` requests as fast as
 possible — the max-throughput mode selected by ``-mi 0``. Both stamp a
-fresh TimeCard (``enqueue_filename``) per request and treat a full
-filename queue as a fatal configuration failure, not backpressure.
+fresh TimeCard (``enqueue_filename``) per request.
+
+A full filename queue is handled per the config's overload policy:
+``"abort"`` (default, reference parity) treats it as a fatal
+configuration failure; ``"shed"`` drops the *new* request with a
+counted ``shed`` outcome — disposed toward the run target through the
+shared counter — and keeps streaming, so a load spike degrades
+success-rate instead of killing the job.
 
 Capability parity with the reference clients (client.py:11-106), as
 threads in the controller process instead of a separate OS process.
@@ -21,17 +27,24 @@ from typing import Optional
 
 import numpy as np
 
-from rnb_tpu.control import NUM_EXIT_MARKERS, TerminationFlag, \
-    TerminationState, send_exit_markers
+from rnb_tpu.control import NUM_EXIT_MARKERS, FaultStats, \
+    InferenceCounter, TerminationFlag, TerminationState, \
+    dispose_requests, send_exit_markers
 from rnb_tpu.telemetry import TimeCard
 from rnb_tpu.utils.class_utils import load_class
+
+SHED_SITE = "filename_queue"
 
 
 def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
             termination: TerminationState, sta_bar: threading.Barrier,
             fin_bar: threading.Barrier, *, mean_interval_ms: int,
             num_videos: Optional[int], seed: Optional[int],
-            num_markers: int = NUM_EXIT_MARKERS) -> None:
+            num_markers: int = NUM_EXIT_MARKERS,
+            overload_policy: str = "abort",
+            fault_stats: Optional[FaultStats] = None,
+            counter: Optional[InferenceCounter] = None,
+            target_num_videos: Optional[int] = None) -> None:
     try:
         iterator = iter(load_class(video_path_iterator_path)())
         rng = np.random.default_rng(seed)
@@ -57,10 +70,23 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
                 try:
                     filename_queue.put_nowait((None, video_path, time_card))
                 except queue.Full:
-                    print("[WARNING] filename queue is full; aborting")
-                    termination.raise_flag(
-                        TerminationFlag.FILENAME_QUEUE_FULL)
-                    break
+                    if overload_policy == "shed":
+                        # overload: drop the NEW request, count it, and
+                        # keep the stream alive (it still consumes an
+                        # id and counts toward the run target — the
+                        # pipeline owes it no further work)
+                        time_card.mark_shed(SHED_SITE)
+                        if fault_stats is not None:
+                            fault_stats.record_shed(SHED_SITE)
+                        if counter is not None \
+                                and target_num_videos is not None:
+                            dispose_requests(counter, target_num_videos,
+                                             termination)
+                    else:
+                        print("[WARNING] filename queue is full; aborting")
+                        termination.raise_flag(
+                            TerminationFlag.FILENAME_QUEUE_FULL)
+                        break
                 video_count += 1
                 if mean_interval_ms > 0:
                     time.sleep(rng.exponential(mean_interval_ms / 1000.0))
@@ -78,20 +104,22 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
 def poisson_client(video_path_iterator_path, filename_queue,
                    mean_interval_ms, termination, sta_bar, fin_bar,
                    seed: Optional[int] = None,
-                   num_markers: int = NUM_EXIT_MARKERS) -> None:
+                   num_markers: int = NUM_EXIT_MARKERS,
+                   **fault_kwargs) -> None:
     """Open-loop Poisson stream until the job terminates
     (reference client.py:11-59)."""
     _client(video_path_iterator_path, filename_queue, termination, sta_bar,
             fin_bar, mean_interval_ms=mean_interval_ms, num_videos=None,
-            seed=seed, num_markers=num_markers)
+            seed=seed, num_markers=num_markers, **fault_kwargs)
 
 
 def bulk_client(video_path_iterator_path, filename_queue, num_videos,
                 termination, sta_bar, fin_bar,
                 seed: Optional[int] = None,
-                num_markers: int = NUM_EXIT_MARKERS) -> None:
+                num_markers: int = NUM_EXIT_MARKERS,
+                **fault_kwargs) -> None:
     """Enqueue num_videos requests immediately — max-throughput mode
     (reference client.py:61-106)."""
     _client(video_path_iterator_path, filename_queue, termination, sta_bar,
             fin_bar, mean_interval_ms=0, num_videos=num_videos, seed=seed,
-            num_markers=num_markers)
+            num_markers=num_markers, **fault_kwargs)

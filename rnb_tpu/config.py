@@ -28,7 +28,14 @@ from rnb_tpu.devices import DeviceSpec
 RESERVED_KEYWORDS = [
     "model", "queue_groups", "num_shared_tensors", "num_segments",
     "in_queue", "out_queues", "devices", "gpus", "queue_selector",
-    "async_dispatch",
+    "async_dispatch", "max_retries", "retry_backoff_ms",
+]
+
+#: root-level keys with meaning to the runtime (everything else at the
+#: root is rejected to catch typos like "overload_polcy")
+ROOT_KEYWORDS = [
+    "video_path_iterator", "pipeline", "overload_policy",
+    "fault_containment", "fault_plan", "_comment",
 ]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
@@ -84,6 +91,13 @@ class StepConfig:
     #: publish outputs without blocking on device completion (timing
     #: then measures dispatch, not compute — see rnb_tpu.runner)
     async_dispatch: bool = False
+    #: containment retry budget for *transient* errors escaping this
+    #: step's model call (rnb_tpu.faults taxonomy): up to max_retries
+    #: re-attempts with retry_backoff_ms of sleep between them; an
+    #: exhausted budget degrades the request to a contained permanent
+    #: failure. Default 0 = fail on first transient.
+    max_retries: int = 0
+    retry_backoff_ms: float = 10.0
 
     @property
     def effective_shared_tensors(self) -> int:
@@ -103,6 +117,16 @@ class PipelineConfig:
     video_path_iterator: str
     steps: List[StepConfig]
     raw: Dict[str, Any]
+    #: "abort" (reference parity: a full queue kills the job) or
+    #: "shed" (a full queue drops the NEW request with a counted shed
+    #: outcome and the pipeline keeps serving)
+    overload_policy: str = "abort"
+    #: when False, even *classified* transient/permanent errors abort
+    #: the job like any other exception — strict reference semantics
+    fault_containment: bool = True
+    #: validated fault-injection plan dict (rnb_tpu.faults), or None;
+    #: the RNB_FAULT_PLAN env JSON overrides it at launch
+    fault_plan: Optional[Dict[str, Any]] = None
 
     @property
     def num_steps(self) -> int:
@@ -141,6 +165,29 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
     pipeline = raw["pipeline"]
     _expect(isinstance(pipeline, list) and pipeline,
             "'pipeline' must be a non-empty list of steps")
+
+    unknown_root = sorted(set(raw) - set(ROOT_KEYWORDS))
+    _expect(not unknown_root,
+            "config has unknown root key(s) %s — root keys are %s"
+            % (unknown_root, sorted(k for k in ROOT_KEYWORDS
+                                    if k != "_comment")))
+
+    overload_policy = raw.get("overload_policy", "abort")
+    _expect(overload_policy in ("abort", "shed"),
+            "'overload_policy' must be \"abort\" or \"shed\", got %r"
+            % (overload_policy,))
+    fault_containment = raw.get("fault_containment", True)
+    _expect(isinstance(fault_containment, bool),
+            "'fault_containment' must be a boolean")
+    fault_plan = raw.get("fault_plan")
+    if fault_plan is not None:
+        from rnb_tpu.faults import FaultPlan
+        try:
+            # structural validation + step indices against THIS
+            # pipeline (a typo'd step would silently never fire)
+            FaultPlan(fault_plan).check_steps(len(pipeline))
+        except ValueError as e:
+            raise ConfigError("invalid 'fault_plan': %s" % e) from e
 
     steps: List[StepConfig] = []
     prev_out_queues: Optional[set] = None
@@ -258,13 +305,27 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
         _expect(isinstance(async_dispatch, bool),
                 "%s: 'async_dispatch' must be a boolean" % where)
 
+        max_retries = step_raw.get("max_retries", 0)
+        _expect(isinstance(max_retries, int) and max_retries >= 0,
+                "%s: 'max_retries' must be a non-negative integer" % where)
+        retry_backoff_ms = step_raw.get("retry_backoff_ms", 10.0)
+        _expect(isinstance(retry_backoff_ms, (int, float))
+                and retry_backoff_ms >= 0,
+                "%s: 'retry_backoff_ms' must be a non-negative number"
+                % where)
+
         step_extras = {k: v for k, v in step_raw.items()
                        if k not in RESERVED_KEYWORDS}
         steps.append(StepConfig(model=step_raw["model"], groups=groups,
                                 num_segments=num_segments,
                                 num_shared_tensors=num_shared_tensors,
                                 extras=step_extras,
-                                async_dispatch=async_dispatch))
+                                async_dispatch=async_dispatch,
+                                max_retries=max_retries,
+                                retry_backoff_ms=float(retry_backoff_ms)))
 
     return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
-                          steps=steps, raw=raw)
+                          steps=steps, raw=raw,
+                          overload_policy=overload_policy,
+                          fault_containment=fault_containment,
+                          fault_plan=fault_plan)

@@ -84,10 +84,70 @@ class TerminationState:
         return self._value != TerminationFlag.UNSET
 
 
+class FaultStats:
+    """Job-wide fault accounting shared by the client and every stage
+    executor (rnb_tpu.runner containment layer).
+
+    Counts contained permanent failures (with per-reason totals and a
+    bounded dead-letter record of ``(request_id, step_idx, reason)``),
+    shed requests per site, and transient retries. All exact counts;
+    only the dead-letter *detail* list is capped so a pathological run
+    cannot grow controller memory without bound.
+    """
+
+    MAX_DEAD_LETTERS = 1000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.num_failed = 0
+        self.num_shed = 0
+        self.num_retries = 0
+        self.failure_reasons: Dict[str, int] = {}
+        self.shed_sites: Dict[str, int] = {}
+        self.dead_letters: List[tuple] = []
+
+    def record_failure(self, request_ids, step_idx: int,
+                       reason: str) -> None:
+        """Dead-letter one or more requests (a fused batch fails as a
+        unit) with one reason at one step."""
+        with self._lock:
+            self.num_failed += len(request_ids)
+            self.failure_reasons[reason] = \
+                self.failure_reasons.get(reason, 0) + len(request_ids)
+            for rid in request_ids:
+                if len(self.dead_letters) < self.MAX_DEAD_LETTERS:
+                    self.dead_letters.append((rid, step_idx, reason))
+
+    def record_shed(self, site: str, n: int = 1) -> None:
+        with self._lock:
+            self.num_shed += n
+            self.shed_sites[site] = self.shed_sites.get(site, 0) + n
+
+    def record_retries(self, n: int = 1) -> None:
+        with self._lock:
+            self.num_retries += n
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time copy for reports (dead-letter detail included)."""
+        with self._lock:
+            return {
+                "num_failed": self.num_failed,
+                "num_shed": self.num_shed,
+                "num_retries": self.num_retries,
+                "failure_reasons": dict(self.failure_reasons),
+                "shed_sites": dict(self.shed_sites),
+                "dead_letters": list(self.dead_letters),
+            }
+
+
 class InferenceCounter:
-    """Locked global completed-inference counter driving the progress
+    """Locked global disposed-request counter driving the progress
     display and the target-reached check (reference benchmark.py:199-205,
-    runner.py:176-196)."""
+    runner.py:176-196). With the containment layer, *disposed* means
+    completed, contained-failed, or shed — every request the pipeline
+    will never owe further work on counts toward the target, so a run
+    with contained failures still terminates instead of waiting forever
+    for completions that cannot come."""
 
     def __init__(self):
         self._value = 0
@@ -103,6 +163,24 @@ class InferenceCounter:
             old = self._value
             self._value = old + n
             return old, self._value
+
+
+def dispose_requests(counter: InferenceCounter, num_videos: int,
+                     termination: TerminationState,
+                     n: int = 1) -> Tuple[int, int]:
+    """Count n requests as disposed (failed/shed) and raise the
+    target-reached flag when the count crosses the job target.
+
+    The final step's success path keeps its own inline version (it also
+    breaks its hot loop on the crossing); every *other* disposal site —
+    a contained failure at any step, a shed at the client or between
+    stages — funnels through here so the job still terminates when the
+    last outstanding request dies instead of completing.
+    """
+    old, new = counter.add(n)
+    if old < num_videos <= new:
+        termination.raise_flag(TerminationFlag.TARGET_NUM_VIDEOS_REACHED)
+    return old, new
 
 
 def send_exit_markers(target_queue: "queue.Queue",

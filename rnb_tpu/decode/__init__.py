@@ -28,6 +28,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from rnb_tpu.faults import CorruptVideoError
+
 DEFAULT_WIDTH = 112
 DEFAULT_HEIGHT = 112
 SYNTH_PREFIX = "synth://"
@@ -118,7 +120,7 @@ class Y4MDecoder(VideoDecoder):
         with open(video, "rb") as f:
             header = f.readline()
         if not header.startswith(b"YUV4MPEG2"):
-            raise ValueError("%s is not a y4m file" % video)
+            raise CorruptVideoError("%s is not a y4m file" % video)
         width = height = None
         cs = "420"
         for token in header.split()[1:]:
@@ -130,7 +132,8 @@ class Y4MDecoder(VideoDecoder):
             elif tag == b"C":
                 cs = val.decode()
         if not width or not height:
-            raise ValueError("y4m header of %s lacks geometry" % video)
+            raise CorruptVideoError(
+                "y4m header of %s lacks geometry" % video)
         if cs.startswith("420"):
             frame_bytes = width * height * 3 // 2
             subsample = 2
@@ -138,7 +141,8 @@ class Y4MDecoder(VideoDecoder):
             frame_bytes = width * height * 3
             subsample = 1
         else:
-            raise ValueError("unsupported y4m colourspace %s" % cs)
+            raise CorruptVideoError(
+                "unsupported y4m colourspace %s" % cs)
         data_start = len(header)
         size = os.path.getsize(video)
         # each frame: b"FRAME...\n" marker + payload
@@ -146,7 +150,7 @@ class Y4MDecoder(VideoDecoder):
             f.seek(data_start)
             marker = f.readline()
         if not marker.startswith(b"FRAME"):
-            raise ValueError("missing FRAME marker in %s" % video)
+            raise CorruptVideoError("missing FRAME marker in %s" % video)
         stride = len(marker) + frame_bytes
         count = (size - data_start) // stride
         meta = dict(width=width, height=height, subsample=subsample,
@@ -161,6 +165,12 @@ class Y4MDecoder(VideoDecoder):
     def _read_frame(self, f, meta) -> np.ndarray:
         w, h, sub = meta["width"], meta["height"], meta["subsample"]
         payload = f.read(meta["frame_bytes"])
+        if len(payload) < meta["frame_bytes"]:
+            # a file truncated mid-frame must surface as a classified
+            # per-request error, not numpy's bare buffer ValueError
+            raise CorruptVideoError(
+                "truncated y4m frame payload (%d of %d bytes)"
+                % (len(payload), meta["frame_bytes"]))
         y = np.frombuffer(payload, np.uint8, w * h).reshape(h, w)
         cw, ch = w // sub, h // sub
         u = np.frombuffer(payload, np.uint8, cw * ch,
@@ -219,6 +229,10 @@ class Y4MDecoder(VideoDecoder):
         w, h, sub = meta["width"], meta["height"], meta["subsample"]
         cw, ch = w // sub, h // sub
         rows, cols, crows, ccols = maps
+        if len(payload) < meta["frame_bytes"]:
+            raise CorruptVideoError(
+                "truncated y4m frame payload (%d of %d bytes)"
+                % (len(payload), meta["frame_bytes"]))
         y = np.frombuffer(payload, np.uint8, w * h).reshape(h, w)
         u = np.frombuffer(payload, np.uint8, cw * ch,
                           offset=w * h).reshape(ch, cw)
@@ -384,7 +398,8 @@ class MjpegPILDecoder(VideoDecoder):
         if video not in self._index:
             frames = scan_mjpeg_frames(data)
             if not frames:
-                raise ValueError("%s contains no JPEG frames" % video)
+                raise CorruptVideoError(
+                    "%s contains no JPEG frames" % video)
             self._index[video] = frames
         return data, self._index[video]
 
@@ -405,8 +420,14 @@ class MjpegPILDecoder(VideoDecoder):
         for ci, start in enumerate(clip_starts):
             for fi in range(consecutive_frames):
                 off, length = frames[min(start + fi, count - 1)]
-                with Image.open(io.BytesIO(data[off:off + length])) as im:
-                    frame = np.asarray(im.convert("RGB"))
+                try:
+                    with Image.open(io.BytesIO(
+                            data[off:off + length])) as im:
+                        frame = np.asarray(im.convert("RGB"))
+                except (OSError, SyntaxError, ValueError) as e:
+                    # libjpeg's truncation/corruption errors, classified
+                    raise CorruptVideoError(
+                        "%s frame %d: %s" % (video, start + fi, e)) from e
                 out[ci, fi] = Y4MDecoder._box_resize(frame, width, height)
         return out
 
@@ -433,9 +454,14 @@ class MjpegPILDecoder(VideoDecoder):
         for ci, start in enumerate(clip_starts):
             for fi in range(consecutive_frames):
                 off, length = frames[min(start + fi, count - 1)]
-                with Image.open(io.BytesIO(data[off:off + length])) as im:
-                    im.draft("YCbCr", im.size)
-                    ycc = np.asarray(im.convert("YCbCr"))
+                try:
+                    with Image.open(io.BytesIO(
+                            data[off:off + length])) as im:
+                        im.draft("YCbCr", im.size)
+                        ycc = np.asarray(im.convert("YCbCr"))
+                except (OSError, SyntaxError, ValueError) as e:
+                    raise CorruptVideoError(
+                        "%s frame %d: %s" % (video, start + fi, e)) from e
                 if maps is None or maps[0] != ycc.shape[:2]:
                     # maps are per-geometry; frames from external
                     # encoders may legally vary in size mid-file
@@ -502,7 +528,9 @@ def get_decoder(video: str) -> VideoDecoder:
         else:
             key = "y4m" if video.endswith(".y4m") else "mjpeg-pil"
     else:
-        raise ValueError(
+        # classified permanent: the request can never decode, but it
+        # must not take the whole run down under containment
+        raise CorruptVideoError(
             "no decode backend for %r: only synth:// ids, .y4m and "
             ".mjpg/.mjpeg files are supported" % video)
     dec = _DECODER_CACHE.get(key)

@@ -18,6 +18,7 @@ from typing import List, Optional
 import numpy as np
 
 from rnb_tpu.decode import (DEFAULT_HEIGHT, DEFAULT_WIDTH, VideoDecoder)
+from rnb_tpu.faults import CorruptVideoError, TransientDecodeError
 
 _ERR_MSGS = {
     -1: "I/O error",
@@ -113,9 +114,21 @@ def native_available() -> bool:
 
 
 def _check(rc: int, path: str) -> None:
-    if rc != 0:
-        raise ValueError("native y4m decode of %r failed: %s"
-                         % (path, _ERR_MSGS.get(rc, "error %d" % rc)))
+    """Raise the native error code as a *classified* exception
+    (rnb_tpu.faults): -1 (read failed; may succeed on retry) is
+    transient, -2/-3 (malformed/unsupported stream; retrying cannot
+    help) are permanent. Both subclass ValueError, so pre-containment
+    callers are unaffected. -4 (bad argument) stays a plain ValueError
+    — a caller bug should abort, not dead-letter a request."""
+    if rc == 0:
+        return
+    msg = ("native y4m decode of %r failed: %s"
+           % (path, _ERR_MSGS.get(rc, "error %d" % rc)))
+    if rc == -1:
+        raise TransientDecodeError(msg)
+    if rc in (-2, -3):
+        raise CorruptVideoError(msg)
+    raise ValueError(msg)
 
 
 class DecodePool:

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from rnb_tpu.decode import get_decoder
+from rnb_tpu.faults import FATAL, classify_error, fault_reason
 from rnb_tpu.models.r2p1d import checkpoint as ckpt
 from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
                                           LAYER_INPUT_SHAPES, NUM_LAYERS,
@@ -288,11 +289,20 @@ class R2P1DLoader(StageModel):
             if len(samples) >= num_samples:
                 break
         for path in samples:
-            decoder = get_decoder(path)
-            length = decoder.num_frames(path)
-            starts = self.sampler.sample(length,
-                                         video_id=path)[: self.max_clips]
-            self._decode_sync(decoder, path, starts)
+            try:
+                decoder = get_decoder(path)
+                length = decoder.num_frames(path)
+                starts = self.sampler.sample(
+                    length, video_id=path)[: self.max_clips]
+                self._decode_sync(decoder, path, starts)
+            except Exception as e:
+                # warm-up is best-effort: a corrupt sample file must
+                # not kill stage init (the hot loop contains the same
+                # error per-request); unclassified errors still abort
+                if classify_error(e) is FATAL:
+                    raise
+                print("[rnb-tpu] WARNING: decode warm-up skipped %s: %s"
+                      % (path, e))
 
     def _decode_sync(self, decoder, video, starts):
         """Synchronous decode through this loader's pixel path."""
@@ -516,6 +526,18 @@ class R2P1DFusingLoader(R2P1DLoader):
         self.max_hold_ms = float(max_hold_ms)
         self._inflight = deque()  # (handle, video, time_card)
         self._ready = deque()     # (handle, video, time_card, t_ready)
+        # requests whose decode failed with a *classified* error while
+        # their batch was being assembled: (time_card, reason), drained
+        # by the executor's take_failed() protocol (rnb_tpu.runner)
+        self._failed = []
+        # transient re-decode attempts performed inside _wait_contained,
+        # drained by the executor's take_retries() protocol so they
+        # land in the job-wide num_retries accounting
+        self._stage_retries = 0
+        #: (max_retries, retry_backoff_ms) — the executor copies the
+        #: step's schema knobs here after construction (the knobs are
+        #: schema, not model kwargs, so they never arrive via **kwargs)
+        self.fault_retry_budget = (0, 0.0)
 
     def _harvest(self) -> None:
         """Move decode-complete requests from in-flight to ready,
@@ -525,9 +547,70 @@ class R2P1DFusingLoader(R2P1DLoader):
             handle, video, tc = self._inflight.popleft()
             self._ready.append((handle, video, tc, time.monotonic()))
 
+    def _wait_contained(self, handle, video, tc) -> bool:
+        """Wait one decode; True on success. A *transient* failure
+        (rnb_tpu.faults taxonomy) is retried by synchronous re-decode
+        up to the step's ``fault_retry_budget``; a *permanent* failure
+        (or an exhausted budget) parks the request on the take_failed()
+        queue instead of poisoning its batchmates or being
+        mis-attributed to whichever request triggered the emission;
+        unclassified errors stay fatal."""
+        from rnb_tpu.faults import TRANSIENT
+        try:
+            handle.wait(video)
+            return True
+        except Exception as e:
+            kind = classify_error(e)
+            if kind is FATAL:
+                raise
+            reason = fault_reason(e)
+            if kind is TRANSIENT:
+                max_retries, backoff_ms = self.fault_retry_budget
+                for _ in range(int(max_retries)):
+                    self._stage_retries += 1
+                    if backoff_ms > 0:
+                        time.sleep(backoff_ms / 1000.0)
+                    try:
+                        # the failed handle's tickets are already
+                        # retired (wait() retires before raising);
+                        # re-decode synchronously into the handle
+                        decoder = get_decoder(video)
+                        starts = self._sample_starts(decoder, video)
+                        handle.out = self._decode_sync(decoder, video,
+                                                       starts)
+                        return True
+                    except Exception as e2:
+                        kind2 = classify_error(e2)
+                        if kind2 is FATAL:
+                            raise
+                        reason = fault_reason(e2)
+                        if kind2 is not TRANSIENT:
+                            # re-decode reached a permanent verdict:
+                            # further retries cannot help
+                            self._failed.append((tc, reason))
+                            return False
+                reason = "retries-exhausted:" + reason
+            self._failed.append((tc, reason))
+            return False
+
+    def take_failed(self):
+        """Drain internally-contained requests (executor protocol,
+        rnb_tpu.runner._drain_stage_failures)."""
+        out, self._failed = self._failed, []
+        return out
+
+    def take_retries(self) -> int:
+        """Drain the internal transient-retry count (executor
+        protocol): retries performed during fused-batch assembly, fed
+        into the job-wide num_retries accounting."""
+        n, self._stage_retries = self._stage_retries, 0
+        return n
+
     def _emit(self):
         """Fuse ready requests (up to ``fuse`` / the ring max rows)
-        into one padded batch + TimeCardList."""
+        into one padded batch + TimeCardList — or None when every
+        taken request's decode failed (the failures are on the
+        take_failed() queue)."""
         import jax
 
         from rnb_tpu import hostprof
@@ -543,6 +626,14 @@ class R2P1DFusingLoader(R2P1DLoader):
         # max_clips); a silent min() here would mask clip loss instead
         # of surfacing the broken invariant
         assert rows <= cap, (rows, cap)
+        ok = []
+        with hostprof.section("loader.emit_wait+copy"):
+            for handle, video, tc, _ in take:
+                if self._wait_contained(handle, video, tc):
+                    ok.append((handle, tc))
+        if not ok:
+            return None
+        rows = sum(handle.n for handle, _ in ok)
         bucket = self._bucket_for(rows)
         with hostprof.section("loader.emit_alloc"):
             # rows [0, row) are overwritten below; only the padding
@@ -551,8 +642,7 @@ class R2P1DFusingLoader(R2P1DLoader):
             out = np.empty(self._batch_shape(bucket), dtype=np.uint8)
         cards, row = [], 0
         with hostprof.section("loader.emit_wait+copy"):
-            for handle, video, tc, _ in take:
-                handle.wait(video)
+            for handle, tc in ok:
                 out[row:row + handle.n] = handle.out[: handle.n]
                 row += handle.n
                 cards.append(tc)
@@ -620,10 +710,12 @@ class R2P1DFusingLoader(R2P1DLoader):
             # backpressure: retire the oldest decode before accepting
             # more work, then ship what is ready
             handle, video, tc = self._inflight.popleft()
-            handle.wait(video)
-            self._ready.append((handle, video, tc, time.monotonic()))
+            if self._wait_contained(handle, video, tc):
+                self._ready.append((handle, video, tc, time.monotonic()))
             self._harvest()
-            return self._emit()
+            out = self._emit()
+            if out is not None:
+                return out
         return None, None, None
 
     def flush(self):
@@ -631,11 +723,15 @@ class R2P1DFusingLoader(R2P1DLoader):
         (the executor calls flush() until it returns None)."""
         while self._inflight:
             handle, video, tc = self._inflight.popleft()
-            handle.wait(video)
-            self._ready.append((handle, video, tc, time.monotonic()))
-        if not self._ready:
-            return None
-        return self._emit()
+            if self._wait_contained(handle, video, tc):
+                self._ready.append((handle, video, tc, time.monotonic()))
+        while self._ready:
+            out = self._emit()
+            if out is not None:
+                return out
+            # that whole batch failed — its cards are on the
+            # take_failed() queue; keep draining the rest
+        return None
 
     def discard_pending(self) -> None:
         """Abort path (called from the executor's finally): retire

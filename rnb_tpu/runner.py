@@ -16,7 +16,21 @@ Capability parity with the reference's per-process hot loop
   reference runner.py:140-154), pads each segment back to the ring's
   static segment shape, and forks the TimeCard per segment;
 * a crashed stage raises ``INTERNAL_ERROR`` instead of hanging the job
-  (the reference had no failure path for this).
+  (the reference had no failure path for this);
+* **request-level fault containment** (rnb_tpu.faults taxonomy): an
+  error escaping the model call is classified — *transient* errors are
+  retried up to the step's ``max_retries`` with ``retry_backoff_ms``
+  of sleep between attempts, *permanent* errors (and exhausted retry
+  budgets) stamp the request's TimeCard ``failed`` and dead-letter it
+  on the controller while the stream keeps flowing, and everything
+  unclassified stays **fatal** exactly as before (stage-init failures
+  and ring-protocol violations abort the job). Under the config's
+  ``overload_policy: "shed"`` a full downstream queue drops the *new*
+  request with a counted ``shed`` outcome instead of aborting with
+  ``FRAME_QUEUE_FULL``. A configured :class:`rnb_tpu.faults.FaultPlan`
+  is consulted at two hook points (stage stall before the inference
+  span; raise/latency per model-call attempt) so chaos behavior is
+  deterministic and reproducible.
 
 Synchronization fidelity: by default the executor blocks until a
 stage's device output is ready before stamping ``inference_finish`` and
@@ -32,15 +46,18 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from rnb_tpu import hostprof
 from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
-                             InferenceCounter, Signal, TerminationFlag,
-                             TerminationState, send_exit_markers)
+                             FaultStats, InferenceCounter, Signal,
+                             TerminationFlag, TerminationState,
+                             dispose_requests, send_exit_markers)
 from rnb_tpu.devices import DeviceSpec
+from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
 from rnb_tpu.stage import PaddedBatch
 from rnb_tpu.telemetry import TimeCardList, TimeCardSummary, logname
 from rnb_tpu.utils.class_utils import load_class
@@ -99,6 +116,18 @@ class RunnerContext:
     # final-step instances append their TimeCardSummary here so the
     # controller can report aggregate latency percentiles
     summary_sink: Optional[List] = None
+    # -- fault-containment knobs (rnb_tpu.faults / config schema) -----
+    #: False = strict reference semantics: even classified errors abort
+    containment: bool = True
+    #: "abort" (full queue kills the job) | "shed" (drop new requests)
+    overload_policy: str = "abort"
+    #: transient-error retry budget for this step's model call
+    max_retries: int = 0
+    retry_backoff_ms: float = 10.0
+    #: deterministic injection schedule (FaultPlan), or None
+    fault_plan: Optional[Any] = None
+    #: job-wide failed/shed/retry accounting shared with the controller
+    fault_stats: Optional[FaultStats] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -179,6 +208,74 @@ def validate_payload(declared, payload, where: str) -> None:
                 "must match exactly)" % (where, idx, got, want))
 
 
+def _cards_of(time_card) -> list:
+    """The individual TimeCards behind one pipeline item (a fused batch
+    carries several)."""
+    if isinstance(time_card, TimeCardList):
+        return list(time_card.time_cards)
+    return [time_card]
+
+
+def _contain_failure(ctx: RunnerContext, time_card, reason: str,
+                     summary) -> None:
+    """Dead-letter one item's request(s): stamp the card(s) failed,
+    record job-wide accounting, and count the disposal toward the run
+    target so the job still terminates (a failed request will never
+    produce the completion the target otherwise waits for)."""
+    cards = _cards_of(time_card)
+    for tc in cards:
+        if tc.status == "ok":
+            tc.mark_failed(reason)
+    if ctx.fault_stats is not None:
+        ctx.fault_stats.record_failure([tc.id for tc in cards],
+                                       ctx.step_idx, reason)
+    if summary is not None:
+        summary.note_failure(reason, len(cards))
+    dispose_requests(ctx.counter, ctx.num_videos, ctx.termination,
+                     len(cards))
+
+
+def _shed_item(ctx: RunnerContext, time_card, summary) -> None:
+    """Drop one item under ``overload_policy: "shed"`` (downstream
+    queue full): counted, stamped, disposed — never aborts the job."""
+    site = "step%d_out_queue" % ctx.step_idx
+    cards = _cards_of(time_card)
+    for tc in cards:
+        tc.mark_shed(site)
+    if ctx.fault_stats is not None:
+        ctx.fault_stats.record_shed(site, len(cards))
+    if summary is not None:
+        summary.note_shed(len(cards))
+    dispose_requests(ctx.counter, ctx.num_videos, ctx.termination,
+                     len(cards))
+
+
+def _drain_stage_failures(ctx: RunnerContext, take_failed, take_retries,
+                          summary) -> None:
+    """Collect requests a stage contained *internally* (e.g. the fusing
+    loader excluding a corrupt video from a fused batch): stages with
+    intra-stage batching expose ``take_failed() -> [(card, reason)]``
+    and the executor turns each entry into a normal dead-letter —
+    unless containment is disabled, in which case a stage-contained
+    failure still aborts the job (strict reference semantics must not
+    depend on which code path an error took)."""
+    if take_retries is not None:
+        n = take_retries()
+        if n:
+            if ctx.fault_stats is not None:
+                ctx.fault_stats.record_retries(n)
+            if summary is not None:
+                summary.note_retries(n)
+    if take_failed is None:
+        return
+    for tc, reason in take_failed():
+        if not ctx.containment:
+            raise RuntimeError(
+                "request %s failed in-stage (%s) with fault_containment "
+                "disabled" % (getattr(tc, "id", "?"), reason))
+        _contain_failure(ctx, tc, reason, summary)
+
+
 def runner(ctx: RunnerContext) -> None:
     """Thread entry: init the stage, run the hot loop, drain cleanly."""
     summary = TimeCardSummary() if ctx.out_queues is None else None
@@ -214,6 +311,17 @@ def runner(ctx: RunnerContext) -> None:
     ring_counter = 0  # next output slot (reference runner.py:60-61)
     # accumulator stages expose poll() for the idle tick; resolve once
     idle_poll = getattr(model, "poll", None)
+    # stages with intra-stage batching surface internally-contained
+    # request failures through take_failed(); resolve once
+    take_failed = getattr(model, "take_failed", None)
+    take_retries = getattr(model, "take_retries", None)
+    if model is not None and take_failed is not None and ctx.containment:
+        # stages with internal containment retry transients themselves;
+        # hand them the step's schema retry knobs (never model kwargs).
+        # In strict mode the budget stays (0, 0): the stage parks the
+        # failure unretried and the drain below aborts the job, matching
+        # the executor path's first-attempt abort.
+        model.fault_retry_budget = (ctx.max_retries, ctx.retry_backoff_ms)
     old_counter_value = 0
     # loop-invariant hostprof section names, formatted once
     sec_queue_get = "exec%d.queue_get" % ctx.step_idx
@@ -238,6 +346,11 @@ def runner(ctx: RunnerContext) -> None:
     try:
         if model is not None:
             while not ctx.termination.terminated:
+                # dead-letter requests the stage contained internally
+                # during the previous iteration (fused-batch members
+                # whose decode failed)
+                _drain_stage_failures(ctx, take_failed, take_retries,
+                                      summary)
                 handle = None
                 # end-of-stream flush: a marker with an accumulating
                 # stage (batcher) still holding a partial batch emits
@@ -269,7 +382,17 @@ def runner(ctx: RunnerContext) -> None:
                         _sig, nt, tc = item
                         tc.add_device(ctx.device.label)
                         tc.record("runner%d_start" % ctx.step_idx)
-                        pending.append((model.submit(nt, tc), nt, tc))
+                        try:
+                            pending.append((model.submit(nt, tc), nt, tc))
+                        except Exception as exc:
+                            # a submit-time decode error (corrupt
+                            # header, vanished file) fails only this
+                            # request; unclassified errors stay fatal
+                            if classify_error(exc) is FATAL \
+                                    or not ctx.containment:
+                                raise
+                            _contain_failure(ctx, tc, fault_reason(exc),
+                                             summary)
                     if pending:
                         handle, non_tensors, time_card = pending.popleft()
                         signal, tensors = None, None
@@ -329,15 +452,91 @@ def runner(ctx: RunnerContext) -> None:
                     # stamps from when the batcher swallowed them
                     tensors_out, non_tensors_out, time_card = flushed
                 else:
+                    in_card = time_card
+                    rids = None
+                    if ctx.fault_plan is not None:
+                        # injection key: every constituent id (a fault
+                        # matching ANY member of a fused batch affects
+                        # the whole dispatch)
+                        rids = [tc.id for tc in _cards_of(in_card)]
+                        # 'stall' injection wedges the stage BEFORE the
+                        # inference span: the delay surfaces downstream
+                        # as queue wait while this stage's input queue
+                        # backs up — a reproducible overload window
+                        stall = ctx.fault_plan.stall_ms(ctx.step_idx,
+                                                        rids)
+                        if stall > 0:
+                            time.sleep(stall / 1000.0)
                     time_card.record("inference%d_start" % ctx.step_idx)
-                    with hostprof.section(sec_model_call):
-                        if handle is not None:
-                            tensors_out, non_tensors_out, time_card = \
-                                model.complete(handle, non_tensors,
-                                               time_card)
-                        else:
-                            tensors_out, non_tensors_out, time_card = \
-                                model(tensors, non_tensors, time_card)
+                    attempt = 0
+                    failed_reason = None
+                    while True:
+                        try:
+                            if ctx.fault_plan is not None:
+                                ctx.fault_plan.fire(ctx.step_idx, rids,
+                                                    attempt)
+                            with hostprof.section(sec_model_call):
+                                if handle is not None and attempt == 0:
+                                    tensors_out, non_tensors_out, \
+                                        time_card = model.complete(
+                                            handle, non_tensors, in_card)
+                                else:
+                                    # retries re-run the synchronous
+                                    # path even for prefetched work: the
+                                    # failed handle's decode cannot be
+                                    # re-waited, only redone
+                                    tensors_out, non_tensors_out, \
+                                        time_card = model(
+                                            tensors, non_tensors, in_card)
+                            break
+                        except Exception as exc:
+                            if handle is not None:
+                                # this request will never complete() the
+                                # prefetched decode again (retries
+                                # re-decode synchronously; injected
+                                # errors may fire before complete ever
+                                # ran): retire its pool tickets now or
+                                # the decode buffers stay pinned in the
+                                # native pool for the process's life
+                                if hasattr(model, "discard"):
+                                    model.discard(handle, non_tensors)
+                                handle = None
+                            kind = classify_error(exc)
+                            if kind is FATAL or not ctx.containment:
+                                raise  # job-fatal, exactly as before
+                            if getattr(in_card, "sub_id", None) \
+                                    is not None and not (
+                                        kind is TRANSIENT
+                                        and attempt < ctx.max_retries):
+                                # a forked SEGMENT card: dead-lettering
+                                # one segment would strand its siblings
+                                # in the aggregator forever and count
+                                # the request toward the target once
+                                # per segment — segment-parallel steps
+                                # stay fail-fast past the retry budget
+                                raise
+                            if kind is TRANSIENT \
+                                    and attempt < ctx.max_retries:
+                                attempt += 1
+                                if ctx.fault_stats is not None:
+                                    ctx.fault_stats.record_retries(1)
+                                if summary is not None:
+                                    summary.note_retries(1)
+                                if ctx.retry_backoff_ms > 0:
+                                    time.sleep(
+                                        ctx.retry_backoff_ms / 1000.0)
+                                continue
+                            failed_reason = fault_reason(exc)
+                            if kind is TRANSIENT:
+                                failed_reason = ("retries-exhausted:"
+                                                 + failed_reason)
+                            break
+                    if failed_reason is not None:
+                        # permanent failure: dead-letter the request(s)
+                        # and keep the stream flowing
+                        _contain_failure(ctx, in_card, failed_reason,
+                                         summary)
+                        continue
                     if time_card is None:
                         # stage swallowed the item (accumulating batcher
                         # / aggregator) — nothing moves downstream
@@ -349,6 +548,29 @@ def runner(ctx: RunnerContext) -> None:
                     with hostprof.section(sec_device_sync):
                         _block_on(tensors_out)
                 time_card.record("inference%d_finish" % ctx.step_idx)
+
+                out_queue = None
+                if ctx.out_queues is not None:
+                    # route BEFORE the ring publish so a shed decision
+                    # can drop the item while no ring slot holds it (a
+                    # written-but-never-signalled slot would deadlock
+                    # the producer on the next wrap-around)
+                    with hostprof.section(sec_enqueue):
+                        out_idx = selector.select(tensors_out,
+                                                  non_tensors_out,
+                                                  time_card)
+                    out_queue = ctx.out_queues[out_idx]
+                    # forked segment cards are never shed (dropping one
+                    # segment would strand its siblings in the
+                    # aggregator and double-count the request): they
+                    # fall through to the blocking-put backpressure path
+                    if (ctx.overload_policy == "shed"
+                            and out_queue.maxsize > 0
+                            and getattr(time_card, "sub_id", None) is None
+                            and out_queue.qsize() + ctx.num_segments
+                            > out_queue.maxsize):
+                        _shed_item(ctx, time_card, summary)
+                        continue
 
                 if ctx.output_ring is not None:
                     with hostprof.section(sec_ring_publish):
@@ -395,9 +617,6 @@ def runner(ctx: RunnerContext) -> None:
                 else:
                     try:
                         with hostprof.section(sec_enqueue):
-                            out_idx = selector.select(
-                                tensors_out, non_tensors_out, time_card)
-                            out_queue = ctx.out_queues[out_idx]
                             for seg_idx in range(ctx.num_segments):
                                 forked = time_card.fork(seg_idx) \
                                     if ctx.num_segments > 1 else time_card
@@ -409,8 +628,23 @@ def runner(ctx: RunnerContext) -> None:
                                         % len(ctx.output_ring)
                                 else:
                                     sig = None
-                                out_queue.put_nowait(
-                                    (sig, non_tensors_out, forked))
+                                item = (sig, non_tensors_out, forked)
+                                if ctx.overload_policy == "shed":
+                                    # capacity raced away since the
+                                    # pre-check (competing producer):
+                                    # the ring slot is already written,
+                                    # so block with termination polling
+                                    # — bounded backpressure, not abort
+                                    while not ctx.termination.terminated:
+                                        try:
+                                            out_queue.put(
+                                                item,
+                                                timeout=QUEUE_POLL_S)
+                                            break
+                                        except queue.Full:
+                                            continue
+                                else:
+                                    out_queue.put_nowait(item)
                     except queue.Full:
                         print("[WARNING] queue between steps %d and %d is "
                               "full; aborting"
@@ -422,6 +656,10 @@ def runner(ctx: RunnerContext) -> None:
                 # hold more (fusing loaders flush one batch per call);
                 # the loop re-enters the drain branch until flush()
                 # returns None
+            # the final flush may have contained failures after the
+            # last loop-top drain ran
+            _drain_stage_failures(ctx, take_failed, take_retries,
+                                  summary)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)

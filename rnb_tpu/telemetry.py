@@ -78,6 +78,21 @@ class TimeCard:
         # device labels (singleton until a merge combines segments that ran
         # on different devices).
         self.devices: List[tuple] = []
+        # request outcome: "ok" until the containment layer stamps the
+        # card "failed" (dead-lettered) or "shed" (dropped under the
+        # "shed" overload policy) — rnb_tpu.runner / rnb_tpu.client
+        self.status: str = "ok"
+        self.failure_reason: Optional[str] = None
+
+    def mark_failed(self, reason: str) -> None:
+        """Stamp this request permanently failed (dead-letter path)."""
+        self.status = "failed"
+        self.failure_reason = str(reason)
+
+    def mark_shed(self, site: str) -> None:
+        """Stamp this request dropped by the overload policy."""
+        self.status = "shed"
+        self.failure_reason = str(site)
 
     def record(self, key: str, at: Optional[float] = None) -> None:
         """Stamp event ``key`` with the current wall-clock time (or a
@@ -109,6 +124,8 @@ class TimeCard:
             # segment so routing and clip accounting survive the fork
             child.num_clips = self.num_clips
         child.devices = list(self.devices)
+        child.status = self.status
+        child.failure_reason = self.failure_reason
         return child
 
     @staticmethod
@@ -168,6 +185,12 @@ class TimeCard:
             # the content stamp is per-request, identical on every
             # sibling fork — keep it once
             merged.num_clips = ordered[0].num_clips
+        for tc in ordered:
+            # one failed segment fails the merged request
+            if tc.status != "ok":
+                merged.status = tc.status
+                merged.failure_reason = tc.failure_reason
+                break
         return merged
 
 
@@ -219,6 +242,31 @@ class TimeCardSummary:
         # per-record clip counts (0 when the pipeline never stamped
         # num_clips) — feeds clips/sec and MFU accounting in bench.py
         self.clip_counts: List[int] = []
+        # fault accounting (rnb_tpu.runner containment): failed/shed
+        # requests never enter the columnar timing data, so latency
+        # percentiles stay success-only; the counters keep the summary
+        # honest about what the instance dropped along the way.
+        # num_shed is part of the schema for symmetry with the
+        # controller's FaultStats but is structurally 0 in current
+        # topologies: sheds happen at the client and at producing
+        # (non-final) stages, while a summary exists only on final-step
+        # instances — job-level shed counts live in FaultStats/log-meta
+        self.num_failed: int = 0
+        self.num_shed: int = 0
+        self.num_retries: int = 0
+        self.failure_reasons: "OrderedDict[str, int]" = OrderedDict()
+
+    def note_failure(self, reason: str, n: int = 1) -> None:
+        """Count a contained permanent failure (excluded from timings)."""
+        self.num_failed += n
+        self.failure_reasons[reason] = \
+            self.failure_reasons.get(reason, 0) + n
+
+    def note_shed(self, n: int = 1) -> None:
+        self.num_shed += n
+
+    def note_retries(self, n: int = 1) -> None:
+        self.num_retries += n
 
     def register(self, time_card: TimeCard) -> None:
         if not self.summary:
@@ -278,6 +326,24 @@ class TimeCardSummary:
                   % self.num_records())
         for prv, nxt, ms in gaps:
             print("Average time between %s and %s: %f ms" % (prv, nxt, ms))
+        if self.num_failed or self.num_shed or self.num_retries:
+            print("Contained faults: %d failed, %d shed, %d retries (%s)"
+                  % (self.num_failed, self.num_shed, self.num_retries,
+                     ", ".join("%s=%d" % kv
+                               for kv in self.failure_reasons.items())
+                     or "no failures"))
+
+    def faults_line(self) -> Optional[str]:
+        """The ``# faults ...`` trailer of the full report, or None when
+        every request succeeded (keeping fault-free reports byte-stable
+        with the pre-containment schema)."""
+        if not (self.num_failed or self.num_shed or self.num_retries):
+            return None
+        parts = ["# faults num_failed=%d num_shed=%d num_retries=%d"
+                 % (self.num_failed, self.num_shed, self.num_retries)]
+        parts.extend("reason:%s=%d" % kv
+                     for kv in self.failure_reasons.items())
+        return " ".join(parts)
 
     def save_full_report(self, fp: IO[str]) -> None:
         # Per-step device-column widths can differ across records (a merge
@@ -309,3 +375,6 @@ class TimeCardSummary:
                     fp.write(" %s" % (step_devices[col]
                                       if col < len(step_devices) else "-"))
             fp.write("\n")
+        faults = self.faults_line()
+        if faults is not None:
+            fp.write(faults + "\n")

@@ -7,6 +7,14 @@ for the reference's NVVL loader, reference README.md:42-110). Decodes
 every video in a dataset tree sequentially on the calling thread (no
 pool fan-out) so the figure is per-core codec speed, not concurrency.
 
+Clip plan: each video is decoded in whole non-overlapping clips of
+``--consecutive-frames`` frames — every frame of every *whole* clip is
+decoded exactly once; the tail frames past the last whole clip are
+dropped, and a video shorter than one clip contributes no frames at
+all. A dataset where every video is that short would therefore measure
+nothing; the script exits non-zero in that case instead of printing a
+misleading ``{"frames_per_sec": 0.0}``.
+
 Usage::
 
     python scripts/decode_bench.py data/bench_mjpeg [--pixfmt yuv420]
@@ -60,6 +68,13 @@ def main() -> int:
         starts = list(range(0, n - cf + 1, cf))
         plans.append((v, starts))
         total_frames += len(starts) * cf
+    if total_frames == 0:
+        # mirrors the no-videos guard: an all-short-video dataset
+        # (every video < --consecutive-frames) decodes nothing, and a
+        # 0.0 frames/s line with rc 0 would read as a measurement
+        raise SystemExit(
+            "no decodable clips: every video under %s is shorter than "
+            "--consecutive-frames=%d" % (args.dataset, cf))
 
     decode = (dec.decode_clips if args.pixfmt == "rgb"
               else dec.decode_clips_yuv)

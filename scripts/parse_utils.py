@@ -6,13 +6,19 @@ log schema, fixing the staleness the reference shipped with (its parser
 expected an older arg set and the retired ``g%d-r%d.txt`` filename
 scheme; see SURVEY.md §2.1 #15):
 
-* ``logs/<job_id>/log-meta.txt`` — three lines written by
-  rnb_tpu/benchmark.py: an ``Args: Namespace(...)`` repr, start/end
-  wall-clock timestamps, and the termination flag.
+* ``logs/<job_id>/log-meta.txt`` — written by rnb_tpu/benchmark.py: an
+  ``Args: Namespace(...)`` repr, start/end wall-clock timestamps, the
+  termination flag, a ``Faults: num_failed=K num_shed=S num_retries=R``
+  accounting line, and (when any request failed) a ``Failure reasons:``
+  JSON line with per-reason counts.
 * ``logs/<job_id>/<device>-group<g>-<i>.txt`` — one whitespace table
   per final-step instance (rnb_tpu/telemetry.py TimeCardSummary
   .save_full_report): a header of event keys followed by per-step
-  device columns, then one row per completed request.
+  device columns, then one row per completed request. Runs with
+  contained faults append a ``# faults ...`` trailer line (skipped by
+  the table parser; counters land in the meta dict instead).
+* ``logs/<job_id>/failed-requests.txt`` — the controller's dead-letter
+  record, one ``request_id step reason`` line per contained failure.
 
 Public API mirrors the reference: ``parse_meta``, ``get_data`` (one
 job), ``get_data_from_all_logs`` (every job under a log root, returning
@@ -45,7 +51,18 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
     with open(os.path.join(job_dir, "log-meta.txt")) as f:
         lines = f.read().splitlines()
     for line in lines:
-        if line.startswith("Args:"):
+        if line.startswith("Faults:"):
+            # "Faults: num_failed=K num_shed=S num_retries=R"
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta[key] = int(val)
+        elif line.startswith("Failure reasons:"):
+            import json
+            meta["failure_reasons"] = json.loads(line.split(":", 1)[1])
+        elif line.startswith("Shed sites:"):
+            import json
+            meta["shed_sites"] = json.loads(line.split(":", 1)[1])
+        elif line.startswith("Args:"):
             for key, raw in _ARGS_RE.findall(line):
                 raw = raw.strip()
                 if raw[:1] in "'\"":
@@ -78,10 +95,13 @@ def parse_timing_table(path: str) -> pd.DataFrame:
     Timestamp columns stay float; ``device*`` columns stay string. The
     producing replica's identity (from the filename) is attached as
     ``final_device`` / ``final_group`` / ``final_instance`` columns.
+    ``#``-prefixed lines (the ``# faults ...`` trailer of runs with
+    contained failures) are not table rows and are skipped.
     """
     with open(path) as f:
         header = f.readline().split()
-        rows = [line.split() for line in f if line.strip()]
+        rows = [line.split() for line in f
+                if line.strip() and not line.startswith("#")]
     df = pd.DataFrame(rows, columns=header)
     for col in df.columns:
         if not col.startswith("device"):
@@ -92,6 +112,23 @@ def parse_timing_table(path: str) -> pd.DataFrame:
         df["final_group"] = int(m.group("group"))
         df["final_instance"] = int(m.group("instance"))
     return df
+
+
+def parse_dead_letters(job_dir: str) -> pd.DataFrame:
+    """One job's dead-letter record -> DataFrame with ``request_id``,
+    ``step`` and ``reason`` columns; empty when the run contained no
+    failures (the file is only written when there were any)."""
+    path = os.path.join(job_dir, "failed-requests.txt")
+    if not os.path.isfile(path):
+        return pd.DataFrame(columns=["request_id", "step", "reason"])
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip() or line.startswith("#"):
+                continue
+            rid, step, reason = line.split(None, 2)
+            rows.append((int(rid), int(step), reason.strip()))
+    return pd.DataFrame(rows, columns=["request_id", "step", "reason"])
 
 
 def _timing_tables(job_dir: str) -> List[str]:

@@ -18,6 +18,27 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACT = os.path.join(REPO, "MULTICHIP_CONFIGS.json")
 
 
+def test_every_shipped_config_validates_under_extended_schema():
+    """Every configs/*.json parses under the full schema including the
+    robustness keys (overload_policy, fault_containment, fault_plan,
+    per-step retry knobs) — and the shipped set exercises the "shed"
+    overload policy at least once so the non-default path cannot rot
+    unvalidated."""
+    from rnb_tpu.config import load_config
+    policies = set()
+    for path in sorted(glob.glob(os.path.join(REPO, "configs",
+                                              "*.json"))):
+        cfg = load_config(path)  # raises ConfigError on any violation
+        assert cfg.overload_policy in ("abort", "shed")
+        policies.add(cfg.overload_policy)
+        for step in cfg.steps:
+            assert step.max_retries >= 0
+            assert step.retry_backoff_ms >= 0
+    assert "shed" in policies, (
+        "no shipped config exercises overload_policy: \"shed\" — keep "
+        "configs/r2p1d-tiny-shed.json (or an equivalent) in the tree")
+
+
 def test_every_shipped_config_has_an_ok_execution_row():
     assert os.path.exists(ARTIFACT), (
         "MULTICHIP_CONFIGS.json missing — run "
