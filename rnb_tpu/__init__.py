@@ -26,6 +26,8 @@ __version__ = "0.1.0"
 from rnb_tpu.telemetry import TimeCard, TimeCardList, TimeCardSummary
 from rnb_tpu.stage import PaddedBatch, StageModel
 from rnb_tpu.selector import QueueSelector, RoundRobinSelector
-from rnb_tpu.video_path_provider import VideoPathIterator
+from rnb_tpu.video_path_provider import (VideoPathIterator,
+                                         ZipfPathIterator)
+from rnb_tpu.cache import ClipCache
 from rnb_tpu.faults import (CorruptVideoError, FaultPlan, PermanentError,
                             TransientError, classify_error)

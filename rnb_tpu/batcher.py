@@ -21,6 +21,21 @@ from rnb_tpu.telemetry import TimeCardList
 
 MAX_ROWS = 15  # max clips per fused batch, matches the loader's max
 
+_jax_mods = None
+
+
+def _jax_numpy():
+    """Lazily imported, module-cached (jax, jnp) pair: the fused-emit
+    hot path must not pay per-emission interpreter import machinery
+    (sys.modules lookup + module-dict binding) — same idiom as the
+    loader's shared-cache modules."""
+    global _jax_mods
+    if _jax_mods is None:
+        import jax
+        import jax.numpy as jnp
+        _jax_mods = (jax, jnp)
+    return _jax_mods
+
 
 class Batcher(StageModel):
     """Accumulate `batch` requests, then emit one fused PaddedBatch.
@@ -44,9 +59,10 @@ class Batcher(StageModel):
         # shape, not from incoming payloads: under upstream row
         # bucketing an incoming batch's max_rows is its (small) bucket,
         # while the fused batch may legally grow to the ring shape
-        self._declared_max = [int(s[0]) for s in self.output_shape_for(
+        self._declared_shapes = self.output_shape_for(
             shapes=shapes, max_rows=max_rows,
-            consecutive_frames=consecutive_frames, frame_hw=frame_hw)]
+            consecutive_frames=consecutive_frames, frame_hw=frame_hw)
+        self._declared_max = [int(s[0]) for s in self._declared_shapes]
         # same validation as the loader's bucketing: typo'd buckets
         # fail fast instead of silently padding to un-warmed shapes
         self.row_buckets = (normalize_row_buckets(
@@ -56,9 +72,12 @@ class Batcher(StageModel):
         self._time_cards = []
 
     def input_shape(self):
-        # NDHWC, the layout every payload in this framework flows
-        # (loader: models/r2p1d/model.py R2P1DLoader._batch_shape)
-        return ((MAX_ROWS, 8, 112, 112, 3),)
+        # the batcher re-packs whatever it receives, so its input max
+        # shapes ARE its declared output shapes — derived from the
+        # constructor's shapes/max_rows/consecutive_frames/frame_hw,
+        # never the flagship globals (a non-default topology's
+        # declared-vs-actual payload validation depends on this)
+        return self._declared_shapes
 
     @staticmethod
     def output_shape():
@@ -142,8 +161,7 @@ class Batcher(StageModel):
         latency, and the async concat lets the executor thread move on.
         Host numpy payloads keep the numpy path.
         """
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jax_numpy()
 
         same_device = (
             all(isinstance(pb.data, jax.Array) for pb in parts)

@@ -83,6 +83,17 @@ class BenchmarkResult:
     num_retries: int = 0
     failure_reasons: Dict[str, int] = field(default_factory=dict)
     shed_sites: Dict[str, int] = field(default_factory=dict)
+    #: decoded-clip cache accounting (rnb_tpu.cache), summed over every
+    #: cache-owning stage instance; all zero when no step configures
+    #: `cache_mb`. hits+misses = loader-side lookups (including for
+    #: requests that later failed/shed); coalesced = requests that
+    #: shared an in-flight decode instead of re-decoding.
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_inserts: int = 0
+    cache_evictions: int = 0
+    cache_coalesced: int = 0
+    cache_bytes_resident: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -131,6 +142,7 @@ def run_benchmark(config_path: str,
     counter = InferenceCounter()
     termination = TerminationState()
     summary_sink: list = []
+    cache_sink: list = []
     fault_stats = FaultStats()
     fault_plan = FaultPlan.resolve(config.fault_plan)
     if fault_plan is not None:
@@ -161,7 +173,8 @@ def run_benchmark(config_path: str,
     threads = []
     client_kwargs = dict(overload_policy=config.overload_policy,
                          fault_stats=fault_stats, counter=counter,
-                         target_num_videos=num_videos)
+                         target_num_videos=num_videos,
+                         popularity=config.popularity)
     if mean_interval_ms > 0:
         client_args = (config.video_path_iterator,
                        fabric.get_filename_queue(), mean_interval_ms,
@@ -219,6 +232,7 @@ def run_benchmark(config_path: str,
                     retry_backoff_ms=step.retry_backoff_ms,
                     fault_plan=fault_plan,
                     fault_stats=fault_stats,
+                    cache_sink=cache_sink,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -330,6 +344,13 @@ def run_benchmark(config_path: str,
     for t in threads:
         t.join(timeout=60)
 
+    # decoded-clip cache accounting: cache-owning stages appended
+    # their final snapshots before the finish barrier (rnb_tpu.runner)
+    cache_stats = None
+    if cache_sink:
+        from rnb_tpu.cache import aggregate_snapshots
+        cache_stats = aggregate_snapshots(cache_sink)
+
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
     num_shed = faults["num_shed"]
@@ -355,6 +376,15 @@ def run_benchmark(config_path: str,
         if faults["shed_sites"]:
             f.write("Shed sites: %s\n"
                     % json.dumps(faults["shed_sites"], sort_keys=True))
+        if cache_stats is not None:
+            # only cache-enabled runs carry the line, keeping cacheless
+            # logs byte-stable with the pre-cache schema
+            f.write("Cache: hits=%d misses=%d inserts=%d evictions=%d "
+                    "coalesced=%d oversize=%d bytes_resident=%d\n"
+                    % (cache_stats["hits"], cache_stats["misses"],
+                       cache_stats["inserts"], cache_stats["evictions"],
+                       cache_stats["coalesced"], cache_stats["oversize"],
+                       cache_stats["bytes_resident"]))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -387,6 +417,14 @@ def run_benchmark(config_path: str,
               % (num_failed, num_shed, num_retries,
                  ", ".join("%s=%d" % kv for kv in sorted(
                      faults["failure_reasons"].items())) or "-"))
+    if cache_stats is not None and print_progress:
+        lookups = cache_stats["hits"] + cache_stats["misses"]
+        print("Cache: %d hits / %d lookups (%.1f%% hit-rate), "
+              "%d coalesced, %d evictions, %.1f MiB resident"
+              % (cache_stats["hits"], lookups,
+                 100.0 * cache_stats["hits"] / lookups if lookups else 0.0,
+                 cache_stats["coalesced"], cache_stats["evictions"],
+                 cache_stats["bytes_resident"] / (1 << 20)))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -420,6 +458,13 @@ def run_benchmark(config_path: str,
         num_retries=num_retries,
         failure_reasons=dict(faults["failure_reasons"]),
         shed_sites=dict(faults["shed_sites"]),
+        cache_hits=cache_stats["hits"] if cache_stats else 0,
+        cache_misses=cache_stats["misses"] if cache_stats else 0,
+        cache_inserts=cache_stats["inserts"] if cache_stats else 0,
+        cache_evictions=cache_stats["evictions"] if cache_stats else 0,
+        cache_coalesced=cache_stats["coalesced"] if cache_stats else 0,
+        cache_bytes_resident=(cache_stats["bytes_resident"]
+                              if cache_stats else 0),
     )
 
 
@@ -481,6 +526,13 @@ def main(argv=None) -> int:
             plan.check_steps(cfg.num_steps)
         print("fault plan: %s"
               % (plan.describe() if plan is not None else "none"))
+        caches = ", ".join(
+            "step%d: %g MB" % (i, s.extras["cache_mb"])
+            for i, s in enumerate(cfg.steps)
+            if s.extras.get("cache_mb")) or "none"
+        print("clip cache: %s; popularity: %s"
+              % (caches, json.dumps(cfg.popularity, sort_keys=True)
+                 if cfg.popularity else "none"))
         print("rnb_tpu is ready to go!")
         return 0
 

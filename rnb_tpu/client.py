@@ -44,9 +44,31 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
             overload_policy: str = "abort",
             fault_stats: Optional[FaultStats] = None,
             counter: Optional[InferenceCounter] = None,
-            target_num_videos: Optional[int] = None) -> None:
+            target_num_videos: Optional[int] = None,
+            popularity: Optional[dict] = None) -> None:
     try:
-        iterator = iter(load_class(video_path_iterator_path)())
+        source = load_class(video_path_iterator_path)()
+        if popularity is not None:
+            # popularity-skewed replay (config root key "popularity"):
+            # wrap the configured iterator with the seeded Zipf sampler
+            # so the request stream models head-heavy real traffic —
+            # the workload shape the decoded-clip cache (rnb_tpu.cache)
+            # is benchmarked under. Seeded with the job seed: same
+            # seed => identical request sequence.
+            from rnb_tpu.video_path_provider import ZipfPathIterator
+            # derive a CHILD seed for the popularity draws: seeding the
+            # video stream and the Poisson interarrival rng below with
+            # the identical value would hand both generators the same
+            # PCG64 state, deterministically coupling video rank with
+            # the following gap length — a correlation the Poisson+Zipf
+            # workload must not carry
+            zipf_seed = (None if seed is None
+                         else np.random.SeedSequence([seed, 1]))
+            source = ZipfPathIterator(source,
+                                      s=popularity.get("s", 1.0),
+                                      universe=popularity.get("universe"),
+                                      seed=zipf_seed)
+        iterator = iter(source)
         rng = np.random.default_rng(seed)
     except Exception:
         traceback.print_exc()

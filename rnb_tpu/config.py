@@ -35,8 +35,11 @@ RESERVED_KEYWORDS = [
 #: root is rejected to catch typos like "overload_polcy")
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
-    "fault_containment", "fault_plan", "_comment",
+    "fault_containment", "fault_plan", "popularity", "_comment",
 ]
+
+#: keys a root 'popularity' object may carry
+POPULARITY_KEYWORDS = ["dist", "s", "universe"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -127,6 +130,11 @@ class PipelineConfig:
     #: validated fault-injection plan dict (rnb_tpu.faults), or None;
     #: the RNB_FAULT_PLAN env JSON overrides it at launch
     fault_plan: Optional[Dict[str, Any]] = None
+    #: validated request-popularity spec ({"dist": "zipf", "s": ...,
+    #: "universe": ...}), or None for the base iterator's own order;
+    #: the client wraps the video-path iterator with
+    #: rnb_tpu.video_path_provider.ZipfPathIterator when set
+    popularity: Optional[Dict[str, Any]] = None
 
     @property
     def num_steps(self) -> int:
@@ -179,6 +187,29 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
     fault_containment = raw.get("fault_containment", True)
     _expect(isinstance(fault_containment, bool),
             "'fault_containment' must be a boolean")
+    popularity = raw.get("popularity")
+    if popularity is not None:
+        _expect(isinstance(popularity, dict),
+                "'popularity' must be an object")
+        unknown_pop = sorted(set(popularity) - set(POPULARITY_KEYWORDS))
+        _expect(not unknown_pop,
+                "'popularity' has unknown key(s) %s — keys are %s"
+                % (unknown_pop, POPULARITY_KEYWORDS))
+        _expect(popularity.get("dist", "zipf") == "zipf",
+                "'popularity.dist' must be \"zipf\" (the one supported "
+                "distribution), got %r" % (popularity.get("dist"),))
+        s = popularity.get("s", 1.0)
+        _expect(isinstance(s, (int, float)) and not isinstance(s, bool)
+                and s >= 0,
+                "'popularity.s' must be a non-negative number, got %r"
+                % (s,))
+        universe = popularity.get("universe")
+        _expect(universe is None
+                or (isinstance(universe, int)
+                    and not isinstance(universe, bool) and universe >= 1),
+                "'popularity.universe' must be a positive integer, got %r"
+                % (universe,))
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -328,4 +359,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           steps=steps, raw=raw,
                           overload_policy=overload_policy,
                           fault_containment=fault_containment,
-                          fault_plan=fault_plan)
+                          fault_plan=fault_plan,
+                          popularity=popularity)

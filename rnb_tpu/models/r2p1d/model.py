@@ -26,6 +26,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from rnb_tpu import hostprof
+from rnb_tpu.cache import content_key
 from rnb_tpu.decode import get_decoder
 from rnb_tpu.faults import FATAL, classify_error, fault_reason
 from rnb_tpu.models.r2p1d import checkpoint as ckpt
@@ -134,36 +136,62 @@ class _DecodeHandle:
     Mirrors what NVVL's async ``loadfile`` represented (reference
     README.md:46-110): decode has been kicked off, ``wait()`` blocks
     until the clip batch is materialized in ``out``.
+
+    Cache/coalescing variants (rnb_tpu.cache): a ``cached`` handle
+    carries a device-resident hit and owns no decode work at all; a
+    ``leader`` handle is a coalesced follower that shares another
+    in-flight request's decode. A failed ``wait()`` remembers its
+    error and re-raises it on every later wait, so a follower parked
+    on a failed leader observes the same classified failure instead
+    of silently reading a garbage buffer.
     """
 
-    __slots__ = ("out", "n", "pool", "tickets", "future")
+    __slots__ = ("out", "n", "pool", "tickets", "future", "cached",
+                 "leader", "key", "error")
 
-    def __init__(self, out, n, pool=None, tickets=None, future=None):
+    def __init__(self, out, n, pool=None, tickets=None, future=None,
+                 cached=None, leader=None, key=None):
         self.out = out          # uint8 (n, F, H, W, 3), filled async
         self.n = n              # valid clip count
         self.pool = pool        # the DecodePool the tickets belong to
         self.tickets = tickets  # native DecodePool tickets, or None
         self.future = future    # fallback executor future, or None
+        self.cached = cached    # CacheEntry on a cache hit, or None
+        self.leader = leader    # coalesced: the leader's handle, or None
+        self.key = key          # cache key of this decode, or None
+        self.error = None       # sticky decode failure (see class doc)
 
     def wait(self, video: str = "<video>") -> None:
-        if self.tickets:
-            first_error = None
-            for ticket in self.tickets:
-                try:
-                    self.pool.wait(ticket, video)
-                except ValueError as e:
-                    first_error = first_error or e
-            self.tickets = None
-            if first_error is not None:
-                raise first_error
-        if self.future is not None:
-            self.future.result()
-            self.future = None
+        if self.leader is not None:
+            self.leader.wait(video)
+            self.out = self.leader.out
+            return
+        if self.error is not None:
+            raise self.error
+        try:
+            if self.tickets:
+                first_error = None
+                for ticket in self.tickets:
+                    try:
+                        self.pool.wait(ticket, video)
+                    except ValueError as e:
+                        first_error = first_error or e
+                self.tickets = None
+                if first_error is not None:
+                    raise first_error
+            if self.future is not None:
+                self.future.result()
+                self.future = None
+        except Exception as e:
+            self.error = e
+            raise
 
     @property
     def ready(self) -> bool:
         """Non-blocking: has the decode finished? (wait() still
         required to retire tickets / surface errors.)"""
+        if self.leader is not None:
+            return self.leader.ready
         if self.tickets:
             return all(self.pool.peek(t) for t in self.tickets)
         if self.future is not None:
@@ -195,7 +223,8 @@ class R2P1DLoader(StageModel):
                  num_warmups: int = NUM_WARMUPS,
                  raw_output: bool = False,
                  row_buckets=None, prefetch: int = 0,
-                 pixel_path: str = "rgb", **kwargs):
+                 pixel_path: str = "rgb", cache_mb: float = 0,
+                 **kwargs):
         super().__init__(device)
         import jax
         self._jax_device = _resolve(device)
@@ -244,6 +273,28 @@ class R2P1DLoader(StageModel):
         self.prefetch_depth = int(prefetch)
         self._fallback_pool = None  # lazily built thread pool
         self._starts_cache = {}  # video -> clip starts (see _sample_starts)
+        # Device-resident decoded-clip cache + in-flight coalescing
+        # (rnb_tpu.cache): opt-in per config via `cache_mb`. The cached
+        # value is the padded on-device uint8 batch (post-device_put,
+        # pre-preprocess), so a hit skips decode AND host->device
+        # transfer — the two dominant host terms (RESULTS.md round 5) —
+        # and feeds the identical jitted path a miss would, keeping
+        # hit/miss logits bit-identical.
+        self.cache = None
+        self._inflight_keys = None
+        if cache_mb:
+            from rnb_tpu.cache import ClipCache, InflightTable
+            self.cache = ClipCache(cache_mb, device=self._jax_device)
+            self._inflight_keys = InflightTable()
+            # decode-config fingerprint: everything that changes the
+            # decoded bytes or the padded value shape. Clip starts are
+            # deterministic per video id given the sampler config
+            # (sampler.py seeds per id), so no seed belongs here.
+            self._cache_cfg = (
+                "r2p1d", tuple(self.sampler.num_clips_population),
+                tuple(float(p) for p in self.sampler.probabilities),
+                self.consecutive_frames, FRAME_HW, self.pixel_path,
+                self.max_clips, self.row_buckets)
         if self.raw_output or self.pixel_path == "yuv420":
             # raw mode: consumer normalizes on its mesh. yuv420: the
             # network stage's jit owns the whole ingest; the loader
@@ -375,6 +426,29 @@ class R2P1DLoader(StageModel):
                 self._starts_cache[video] = starts
         return starts
 
+    def _cache_lookup(self, video: str):
+        """(key, entry) for one request — (None, None) when caching is
+        off. Counted and hostprof-sectioned: the lookup (one stat + one
+        dict probe) is the only cost a cache-enabled miss adds."""
+        if self.cache is None:
+            return None, None
+        with hostprof.section("loader.cache_lookup"):
+            key = content_key(video, self._cache_cfg)
+            entry = self.cache.lookup(key)
+        return key, entry
+
+    def _materialize_hit(self, entry, time_card):
+        """Serve one request from a cache entry: no decode, no
+        transfer — straight into the same jitted preprocess a miss
+        feeds (or as-is for raw/yuv420 consumers)."""
+        time_card.num_clips = entry.valid
+        time_card.cache_hit = True
+        if self._preprocess is None:
+            return (PaddedBatch(entry.batch, entry.valid),), None, \
+                time_card
+        return (PaddedBatch(self._preprocess(entry.batch),
+                            entry.valid),), None, time_card
+
     def submit(self, non_tensors, time_card) -> _DecodeHandle:
         """Kick off decode of one request; pair with :meth:`complete`.
 
@@ -382,9 +456,36 @@ class R2P1DLoader(StageModel):
         the C++ worker pool immediately); other backends decode on a
         small fallback thread pool. Either way the calling executor
         thread returns without blocking on pixel work.
+
+        With the clip cache enabled, a hit returns a work-free cached
+        handle, and a request whose key is already decoding in the
+        prefetch window coalesces onto that leader (shares its decoded
+        buffer — no second decode) instead of re-submitting.
         """
-        from rnb_tpu import hostprof
         video = str(non_tensors)
+        key, entry = self._cache_lookup(video)
+        if entry is not None:
+            time_card.num_clips = entry.valid
+            time_card.cache_hit = True
+            return _DecodeHandle(None, entry.valid, cached=entry)
+        if key is not None:
+            time_card.cache_hit = False
+            leader = self._inflight_keys.get(key)
+            if leader is not None:
+                time_card.num_clips = leader.n
+                time_card.cache_coalesced = True
+                self.cache.note_coalesced()
+                return _DecodeHandle(None, leader.n, leader=leader)
+        handle = self._decode_submit(video, time_card)
+        if key is not None:
+            handle.key = key
+            self._inflight_keys.put(key, handle)
+        return handle
+
+    def _decode_submit(self, video: str, time_card) -> _DecodeHandle:
+        """The raw async-decode kickoff behind :meth:`submit` — no
+        cache interaction (the fusing loader runs its own lookup and
+        coalescing around this)."""
         with hostprof.section("loader.probe+sample"):
             decoder = get_decoder(video)
             starts = self._sample_starts(decoder, video)
@@ -437,8 +538,15 @@ class R2P1DLoader(StageModel):
         handle.future = self._fallback_pool.submit(_work)
         return handle
 
-    def _materialize(self, clips: np.ndarray, n: int, time_card):
-        """Pad decoded clips to their row bucket, transfer, normalize."""
+    def _materialize(self, clips: np.ndarray, n: int, time_card,
+                     cache_key=None):
+        """Pad decoded clips to their row bucket, transfer, normalize.
+
+        With ``cache_key`` set, the freshly transferred padded device
+        batch is inserted into the clip cache — insert-after-success
+        only: this line is reached only once decode and transfer both
+        completed, so failed/contained requests never populate entries.
+        """
         import jax
         target = self._batch_shape(self._bucket_for(n))
         if clips.shape == target:
@@ -449,6 +557,11 @@ class R2P1DLoader(StageModel):
             padded = np.zeros(target, dtype=np.uint8)
             padded[:n] = clips
         device_u8 = jax.device_put(padded, self._jax_device)
+        if cache_key is not None and self.cache is not None:
+            # zero-copy insert: the padded device array IS the cached
+            # value (immutable jax.Array) — no extra transfer
+            with hostprof.section("loader.cache_insert"):
+                self.cache.insert_device(cache_key, device_u8, n)
         if self._preprocess is None:
             # raw_output (mesh consumer) or yuv420 (network stage owns
             # the fused ingest): u8 crosses the wire as-is
@@ -457,9 +570,26 @@ class R2P1DLoader(StageModel):
         return (PaddedBatch(batch, n),), None, time_card
 
     def complete(self, handle: _DecodeHandle, non_tensors, time_card):
-        """Wait for a submitted decode, then pad/transfer/normalize."""
-        handle.wait(str(non_tensors))
-        return self._materialize(handle.out, handle.n, time_card)
+        """Wait for a submitted decode, then pad/transfer/normalize
+        (or serve the cached/coalesced result without decode work)."""
+        if handle.cached is not None:
+            return self._materialize_hit(handle.cached, time_card)
+        if handle.leader is not None:
+            # coalesced follower: the leader decoded for both; a failed
+            # leader re-raises its classified error here (containment
+            # then dead-letters this request too). No cache insert —
+            # the leader already did it.
+            handle.wait(str(non_tensors))
+            return self._materialize(handle.out, handle.n, time_card)
+        try:
+            handle.wait(str(non_tensors))
+        finally:
+            # the decode is finalized either way: later requests for
+            # this key consult the cache (success) or decode afresh
+            if self._inflight_keys is not None:
+                self._inflight_keys.pop(handle.key)
+        return self._materialize(handle.out, handle.n, time_card,
+                                 cache_key=handle.key)
 
     def discard(self, handle: _DecodeHandle, non_tensors=None) -> None:
         """Retire a submitted decode whose result will never be used
@@ -468,18 +598,41 @@ class R2P1DLoader(StageModel):
             handle.wait(str(non_tensors))
         except Exception:
             pass  # abort path: decode errors are moot
+        if self._inflight_keys is not None:
+            self._inflight_keys.pop(getattr(handle, "key", None))
 
     def __call__(self, tensors, non_tensors, time_card):
         # synchronous path (no prefetching executor, R2P1DSingleStep):
         # decode inline on the calling thread — no thread-pool hop, no
         # extra staging copy on the hot path
         video = str(non_tensors)
+        key, entry = self._cache_lookup(video)
+        if entry is not None:
+            return self._materialize_hit(entry, time_card)
         decoder = get_decoder(video)
         starts = self._sample_starts(decoder, video)
         clips = self._decode_sync(decoder, video, starts)
         n = clips.shape[0]
         time_card.num_clips = n
-        return self._materialize(clips, n, time_card)
+        if key is not None:
+            time_card.cache_hit = False
+        return self._materialize(clips, n, time_card, cache_key=key)
+
+
+class _FuseRecord:
+    """One in-flight/ready request of the fusing loader: the decode
+    handle plus every TimeCard riding on it — the leader's and any
+    coalesced followers' (rnb_tpu.cache), which share the single
+    decode and the single fused emission."""
+
+    __slots__ = ("handle", "video", "cards", "key", "t_ready")
+
+    def __init__(self, handle, video, card, key=None):
+        self.handle = handle
+        self.video = video
+        self.cards = [card]
+        self.key = key       # cache key, or None when caching is off
+        self.t_ready = 0.0   # monotonic instant the decode was harvested
 
 
 class R2P1DFusingLoader(R2P1DLoader):
@@ -524,8 +677,8 @@ class R2P1DFusingLoader(R2P1DLoader):
         self.fuse = int(fuse)
         self.depth = int(depth) if depth is not None else 2 * self.fuse
         self.max_hold_ms = float(max_hold_ms)
-        self._inflight = deque()  # (handle, video, time_card)
-        self._ready = deque()     # (handle, video, time_card, t_ready)
+        self._inflight = deque()  # _FuseRecord, decode still running
+        self._ready = deque()     # _FuseRecord, decode complete
         # requests whose decode failed with a *classified* error while
         # their batch was being assembled: (time_card, reason), drained
         # by the executor's take_failed() protocol (rnb_tpu.runner)
@@ -543,19 +696,33 @@ class R2P1DFusingLoader(R2P1DLoader):
         """Move decode-complete requests from in-flight to ready,
         preserving FIFO order (a slow head occupies the whole pool
         anyway, so out-of-order harvest buys nothing)."""
-        while self._inflight and self._inflight[0][0].ready:
-            handle, video, tc = self._inflight.popleft()
-            self._ready.append((handle, video, tc, time.monotonic()))
+        while self._inflight and self._inflight[0].handle.ready:
+            rec = self._inflight.popleft()
+            rec.t_ready = time.monotonic()
+            self._ready.append(rec)
 
-    def _wait_contained(self, handle, video, tc) -> bool:
+    def _drop_coalesce(self, rec: "_FuseRecord") -> None:
+        """Close a record's coalescing window (it is being finalized):
+        later requests for its key consult the cache or re-decode."""
+        if self._inflight_keys is not None:
+            self._inflight_keys.pop(rec.key)
+
+    def _park_failed(self, rec: "_FuseRecord", reason: str) -> None:
+        """Every card riding this record — leader and coalesced
+        followers — fails as a unit; none is ever cached."""
+        self._drop_coalesce(rec)
+        self._failed.extend((tc, reason) for tc in rec.cards)
+
+    def _wait_contained(self, rec: "_FuseRecord") -> bool:
         """Wait one decode; True on success. A *transient* failure
         (rnb_tpu.faults taxonomy) is retried by synchronous re-decode
         up to the step's ``fault_retry_budget``; a *permanent* failure
-        (or an exhausted budget) parks the request on the take_failed()
-        queue instead of poisoning its batchmates or being
-        mis-attributed to whichever request triggered the emission;
-        unclassified errors stay fatal."""
+        (or an exhausted budget) parks the request(s) on the
+        take_failed() queue instead of poisoning its batchmates or
+        being mis-attributed to whichever request triggered the
+        emission; unclassified errors stay fatal."""
         from rnb_tpu.faults import TRANSIENT
+        handle, video = rec.handle, rec.video
         try:
             handle.wait(video)
             return True
@@ -578,6 +745,7 @@ class R2P1DFusingLoader(R2P1DLoader):
                         starts = self._sample_starts(decoder, video)
                         handle.out = self._decode_sync(decoder, video,
                                                        starts)
+                        handle.error = None  # recovered (sticky wait)
                         return True
                     except Exception as e2:
                         kind2 = classify_error(e2)
@@ -587,10 +755,10 @@ class R2P1DFusingLoader(R2P1DLoader):
                         if kind2 is not TRANSIENT:
                             # re-decode reached a permanent verdict:
                             # further retries cannot help
-                            self._failed.append((tc, reason))
+                            self._park_failed(rec, reason)
                             return False
                 reason = "retries-exhausted:" + reason
-            self._failed.append((tc, reason))
+            self._park_failed(rec, reason)
             return False
 
     def take_failed(self):
@@ -613,14 +781,18 @@ class R2P1DFusingLoader(R2P1DLoader):
         take_failed() queue)."""
         import jax
 
-        from rnb_tpu import hostprof
         cap = self.max_clips
         take, rows = [], 0
         while self._ready and len(take) < self.fuse:
-            handle = self._ready[0][0]
+            handle = self._ready[0].handle
             if take and rows + handle.n > cap:
                 break
-            take.append(self._ready.popleft())
+            rec = self._ready.popleft()
+            # finalizing: close the coalescing window now — by the time
+            # a later same-key request arrives, the successful decode is
+            # in the cache (inserted below, same call)
+            self._drop_coalesce(rec)
+            take.append(rec)
             rows += handle.n
         # the take loop guarantees this (submit caps each request at
         # max_clips); a silent min() here would mask clip loss instead
@@ -628,12 +800,12 @@ class R2P1DFusingLoader(R2P1DLoader):
         assert rows <= cap, (rows, cap)
         ok = []
         with hostprof.section("loader.emit_wait+copy"):
-            for handle, video, tc, _ in take:
-                if self._wait_contained(handle, video, tc):
-                    ok.append((handle, tc))
+            for rec in take:
+                if self._wait_contained(rec):
+                    ok.append(rec)
         if not ok:
             return None
-        rows = sum(handle.n for handle, _ in ok)
+        rows = sum(rec.handle.n for rec in ok)
         bucket = self._bucket_for(rows)
         with hostprof.section("loader.emit_alloc"):
             # rows [0, row) are overwritten below; only the padding
@@ -642,12 +814,25 @@ class R2P1DFusingLoader(R2P1DLoader):
             out = np.empty(self._batch_shape(bucket), dtype=np.uint8)
         cards, row = [], 0
         with hostprof.section("loader.emit_wait+copy"):
-            for handle, tc in ok:
-                out[row:row + handle.n] = handle.out[: handle.n]
-                row += handle.n
-                cards.append(tc)
+            for rec in ok:
+                n = rec.handle.n
+                out[row:row + n] = rec.handle.out[:n]
+                row += n
+                cards.extend(rec.cards)
             if row < out.shape[0]:
                 out[row:] = 0
+        if self.cache is not None:
+            # insert-after-success: only decodes that reached this
+            # point populate the cache. The fused batch crosses the
+            # wire as one array, so each entry pays its own (first and
+            # only) transfer here — hits amortize it away.
+            with hostprof.section("loader.cache_insert"):
+                for rec in ok:
+                    if rec.key is not None:
+                        n = rec.handle.n
+                        self.cache.insert_host(
+                            rec.key, rec.handle.out, n,
+                            self._batch_shape(self._bucket_for(n)))
         with hostprof.section("loader.device_put"):
             batch = jax.device_put(out, self._jax_device)
         if self._preprocess is not None:
@@ -655,6 +840,15 @@ class R2P1DFusingLoader(R2P1DLoader):
                 batch = self._preprocess(batch)
         from rnb_tpu.telemetry import TimeCardList
         return ((PaddedBatch(batch, row),), None, TimeCardList(cards))
+
+    def _emit_hit(self, entry, time_card):
+        """A cache hit emits immediately as its own dispatch: there is
+        no decode to overlap and no host work to amortize, so holding
+        it for fusion would only add latency. Wrapped in a TimeCardList
+        for schema uniformity with fused emissions."""
+        from rnb_tpu.telemetry import TimeCardList
+        tensors, non_tensors, tc = self._materialize_hit(entry, time_card)
+        return tensors, non_tensors, TimeCardList([tc])
 
     #: harvest-check tick while decodes are in flight but nothing is
     #: ready: bounds how late a completed decode is noticed
@@ -671,7 +865,7 @@ class R2P1DFusingLoader(R2P1DLoader):
         if self._ready:
             if not self._inflight:
                 return 0.0  # nothing else can fuse: emit now
-            waited = time.monotonic() - self._ready[0][3]
+            waited = time.monotonic() - self._ready[0].t_ready
             remaining = max(0.0, self.max_hold_ms / 1000.0 - waited)
             # two triggers race: the hold expiry AND an in-flight
             # decode completing (which can satisfy the fuse/rows/
@@ -691,27 +885,53 @@ class R2P1DFusingLoader(R2P1DLoader):
         self._harvest()
         if not self._ready:
             return None
-        rows_ready = sum(h.n for h, _, _, _ in self._ready)
+        rows_ready = sum(rec.handle.n for rec in self._ready)
         if (len(self._ready) >= self.fuse
                 or rows_ready >= self.max_clips
                 or not self._inflight
-                or (time.monotonic() - self._ready[0][3]) * 1000.0
+                or (time.monotonic() - self._ready[0].t_ready) * 1000.0
                 > self.max_hold_ms):
             return self._emit()
         return None
 
     def __call__(self, tensors, non_tensors, time_card):
-        handle = self.submit(non_tensors, time_card)
-        self._inflight.append((handle, str(non_tensors), time_card))
+        video = str(non_tensors)
+        key, entry = self._cache_lookup(video)
+        if entry is not None:
+            # hit: serve from the device-resident entry right now — no
+            # decode, no transfer, no fuse wait
+            return self._emit_hit(entry, time_card)
+        if key is not None:
+            time_card.cache_hit = False
+            live = self._inflight_keys.get(key)
+            if live is not None:
+                # coalesce: park this request on the in-flight decode;
+                # it rides the leader's fused emission through the
+                # TimeCardList fan-out (one decode, one row range, N
+                # stamped cards)
+                time_card.num_clips = live.handle.n
+                time_card.cache_coalesced = True
+                self.cache.note_coalesced()
+                live.cards.append(time_card)
+                out = self.poll()
+                if out is not None:
+                    return out
+                return None, None, None
+        handle = self._decode_submit(video, time_card)
+        rec = _FuseRecord(handle, video, time_card, key=key)
+        if key is not None:
+            self._inflight_keys.put(key, rec)
+        self._inflight.append(rec)
         out = self.poll()  # harvest + the emission rules
         if out is not None:
             return out
         if len(self._inflight) >= self.depth:
             # backpressure: retire the oldest decode before accepting
             # more work, then ship what is ready
-            handle, video, tc = self._inflight.popleft()
-            if self._wait_contained(handle, video, tc):
-                self._ready.append((handle, video, tc, time.monotonic()))
+            rec = self._inflight.popleft()
+            if self._wait_contained(rec):
+                rec.t_ready = time.monotonic()
+                self._ready.append(rec)
             self._harvest()
             out = self._emit()
             if out is not None:
@@ -722,9 +942,10 @@ class R2P1DFusingLoader(R2P1DLoader):
         """End-of-stream: drain everything, one fused batch per call
         (the executor calls flush() until it returns None)."""
         while self._inflight:
-            handle, video, tc = self._inflight.popleft()
-            if self._wait_contained(handle, video, tc):
-                self._ready.append((handle, video, tc, time.monotonic()))
+            rec = self._inflight.popleft()
+            if self._wait_contained(rec):
+                rec.t_ready = time.monotonic()
+                self._ready.append(rec)
         while self._ready:
             out = self._emit()
             if out is not None:
@@ -738,10 +959,9 @@ class R2P1DFusingLoader(R2P1DLoader):
         every submitted decode so native tickets don't pin buffers
         forever. Ready-but-unemitted handles hold un-retired tickets
         too — harvest only peeks, it never waits."""
-        for handle, video, _ in self._inflight:
-            self.discard(handle, video)
-        for handle, video, _, _ in self._ready:
-            self.discard(handle, video)
+        for rec in list(self._inflight) + list(self._ready):
+            self._drop_coalesce(rec)
+            self.discard(rec.handle, rec.video)
         self._inflight.clear()
         self._ready.clear()
 
@@ -886,6 +1106,9 @@ class R2P1DSingleStep(StageModel):
         self.loader = R2P1DLoader(device, max_clips=max_clips,
                                   consecutive_frames=consecutive_frames,
                                   num_warmups=num_warmups, **kwargs)
+        # surface the embedded loader's clip cache (if configured) so
+        # the executor's cache-stats sink sees it (rnb_tpu.runner)
+        self.cache = self.loader.cache
         # the inner runner must warm the same bucket shapes the loader
         # emits, or the first occurrence of each bucket would pay a
         # silent XLA recompile inside the measured window
@@ -1153,6 +1376,10 @@ class R2P1DVideoPathIterator(VideoPathIterator):
                       for i in range(num_synthetic)]
         self._videos = videos
         self._cycle = itertools.cycle(videos)
+
+    def dataset(self):
+        """Finite universe for popularity wrappers (ZipfPathIterator)."""
+        return list(self._videos)
 
     def __iter__(self):
         return self._cycle

@@ -128,6 +128,11 @@ class RunnerContext:
     fault_plan: Optional[Any] = None
     #: job-wide failed/shed/retry accounting shared with the controller
     fault_stats: Optional[FaultStats] = None
+    #: stages owning a clip cache (rnb_tpu.cache: `cache_mb` on a
+    #: loader step) append their final cache snapshot here so the
+    #: controller can report job-wide hit/miss/eviction/coalesced
+    #: counts (BenchmarkResult + log-meta `Cache:` line)
+    cache_sink: Optional[List] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -708,6 +713,15 @@ def runner(ctx: RunnerContext) -> None:
         if model is not None and hasattr(model, "finalize"):
             try:
                 model.finalize()
+            except Exception:
+                traceback.print_exc()
+        # cache-owning stages report their final counters before the
+        # finish barrier (all stage work is done by here), so the
+        # controller's aggregation never races a live counter
+        if (ctx.cache_sink is not None
+                and getattr(model, "cache", None) is not None):
+            try:
+                ctx.cache_sink.append(model.cache.snapshot())
             except Exception:
                 traceback.print_exc()
         try:

@@ -60,6 +60,13 @@ def latency_percentiles(latencies_ms: Sequence[float],
     return {p: float(np.percentile(latencies_ms, p)) for p in percentiles}
 
 
+#: per-request content stamps set by the loader that must survive
+#: fork/merge: clip count (routing, MFU accounting) and the cache
+#: outcome (rnb_tpu.cache: True=hit, False=miss; cache_coalesced marks
+#: a request that shared another request's in-flight decode)
+CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced")
+
+
 class TimeCard:
     """An ordered event->timestamp record that rides along with a request.
 
@@ -119,10 +126,12 @@ class TimeCard:
         child.timings = OrderedDict(self.timings)
         child.sub_id = sub_id
         child.num_parent_timings = len(self.timings)
-        if hasattr(self, "num_clips"):
-            # content stamps (loader's num_clips) ride along with every
-            # segment so routing and clip accounting survive the fork
-            child.num_clips = self.num_clips
+        for attr in CONTENT_STAMPS:
+            # content stamps (loader's num_clips / cache outcome) ride
+            # along with every segment so routing, clip accounting and
+            # cache attribution survive the fork
+            if hasattr(self, attr):
+                setattr(child, attr, getattr(self, attr))
         child.devices = list(self.devices)
         child.status = self.status
         child.failure_reason = self.failure_reason
@@ -181,10 +190,11 @@ class TimeCard:
                 merged.devices.append((flat[0],))
             else:
                 merged.devices.append(flat)
-        if hasattr(ordered[0], "num_clips"):
-            # the content stamp is per-request, identical on every
-            # sibling fork — keep it once
-            merged.num_clips = ordered[0].num_clips
+        for attr in CONTENT_STAMPS:
+            # content stamps are per-request, identical on every
+            # sibling fork — keep them once
+            if hasattr(ordered[0], attr):
+                setattr(merged, attr, getattr(ordered[0], attr))
         for tc in ordered:
             # one failed segment fails the merged request
             if tc.status != "ok":
@@ -255,6 +265,13 @@ class TimeCardSummary:
         self.num_shed: int = 0
         self.num_retries: int = 0
         self.failure_reasons: "OrderedDict[str, int]" = OrderedDict()
+        # decoded-clip cache attribution (rnb_tpu.cache): registered
+        # completions whose card carries a cache_hit stamp. tracked=0
+        # means the pipeline ran cacheless and the report stays
+        # byte-stable with the pre-cache schema.
+        self.num_cache_hits: int = 0
+        self.num_cache_coalesced: int = 0
+        self.num_cache_tracked: int = 0
 
     def note_failure(self, reason: str, n: int = 1) -> None:
         """Count a contained permanent failure (excluded from timings)."""
@@ -280,7 +297,22 @@ class TimeCardSummary:
         for key, ts in time_card.timings.items():
             self.summary[key].append(ts)
         self.devices_per_inference.append(time_card.devices)
-        self.clip_counts.append(int(getattr(time_card, "num_clips", 0)))
+        # clip_counts feeds clips/sec and MFU — DEVICE-WORK accounting.
+        # A coalesced follower's rows were computed once, on the
+        # leader's card; counting them again would inflate the device
+        # utilization the honesty policy protects, so followers
+        # contribute 0 here (their num_clips stamp remains on the card
+        # for routing/request-level analysis).
+        coalesced = getattr(time_card, "cache_coalesced", False)
+        self.clip_counts.append(
+            0 if coalesced else int(getattr(time_card, "num_clips", 0)))
+        hit = getattr(time_card, "cache_hit", None)
+        if hit is not None:
+            self.num_cache_tracked += 1
+            if hit:
+                self.num_cache_hits += 1
+        if getattr(time_card, "cache_coalesced", False):
+            self.num_cache_coalesced += 1
 
     def total_clips(self) -> int:
         """Sum of registered records' ``num_clips`` stamps."""
@@ -332,6 +364,10 @@ class TimeCardSummary:
                      ", ".join("%s=%d" % kv
                                for kv in self.failure_reasons.items())
                      or "no failures"))
+        if self.num_cache_tracked:
+            print("Clip cache: %d/%d completions were hits, %d coalesced"
+                  % (self.num_cache_hits, self.num_cache_tracked,
+                     self.num_cache_coalesced))
 
     def faults_line(self) -> Optional[str]:
         """The ``# faults ...`` trailer of the full report, or None when
@@ -344,6 +380,17 @@ class TimeCardSummary:
         parts.extend("reason:%s=%d" % kv
                      for kv in self.failure_reasons.items())
         return " ".join(parts)
+
+    def cache_line(self) -> Optional[str]:
+        """The ``# cache ...`` trailer, or None for cacheless runs
+        (keeping their reports byte-stable with the pre-cache schema).
+        Written even when hits=0 on a cache-enabled run — a zero
+        hit-rate is a result, not an absence of data."""
+        if not self.num_cache_tracked:
+            return None
+        return ("# cache num_hits=%d num_coalesced=%d num_tracked=%d"
+                % (self.num_cache_hits, self.num_cache_coalesced,
+                   self.num_cache_tracked))
 
     def save_full_report(self, fp: IO[str]) -> None:
         # Per-step device-column widths can differ across records (a merge
@@ -378,3 +425,6 @@ class TimeCardSummary:
         faults = self.faults_line()
         if faults is not None:
             fp.write(faults + "\n")
+        cache = self.cache_line()
+        if cache is not None:
+            fp.write(cache + "\n")

@@ -41,3 +41,97 @@ class VideoPathIterator:
 
     def __iter__(self):
         raise NotImplementedError
+
+    def dataset(self):
+        """The finite video universe behind this iterator, or None when
+        unknown. Popularity-skewed wrappers (:class:`ZipfPathIterator`)
+        use it to assign ranks; iterators without a materialized list
+        may return None and the wrapper falls back to drawing distinct
+        items from the cycle."""
+        return None
+
+
+#: fallback universe size when a base iterator exposes no dataset():
+#: bounded so materializing distinct items from an endless cycle halts
+DEFAULT_UNIVERSE = 1024
+
+
+def zipf_probabilities(universe: int, s: float):
+    """Rank-frequency Zipf pmf over ranks 1..universe: p(r) ∝ r^-s.
+
+    ``s=0`` degenerates to the uniform distribution; larger ``s``
+    concentrates mass on the head. Pure numpy, importable by tooling
+    without the model stack.
+    """
+    import numpy as np
+    if universe < 1:
+        raise ValueError("universe must be >= 1, got %r" % (universe,))
+    if s < 0:
+        raise ValueError("zipf skew s must be >= 0, got %r" % (s,))
+    weights = np.arange(1, universe + 1, dtype=np.float64) ** -float(s)
+    return weights / weights.sum()
+
+
+class ZipfPathIterator(VideoPathIterator):
+    """Popularity-skewed wrapper: draw paths from a base iterator's
+    universe with Zipf(s) rank frequencies.
+
+    Rank assignment is deterministic — rank r maps to the r-th video of
+    the base iterator's (sorted-scan) dataset — and the draw stream is
+    seeded, so the same (dataset, s, universe, seed) produces the
+    identical request sequence: the reproducibility the cache benchmark
+    cell needs for honest A/Bs. ``universe`` restricts popularity to
+    the first N videos and clamps to the dataset size (a universe
+    larger than the dataset cannot invent videos).
+
+    Config: root key ``popularity: {"dist": "zipf", "s": 1.1,
+    "universe": 64}`` (rnb_tpu.config) — the client wraps the
+    configured ``video_path_iterator`` with this class.
+    """
+
+    def __init__(self, base, s: float = 1.0, universe=None, seed=None):
+        super().__init__()
+        videos = base.dataset() if hasattr(base, "dataset") else None
+        if videos is None:
+            # endless-cycle base: materialize the first `universe`
+            # distinct items (the cycle revisits its population, so a
+            # full lap yields every id)
+            want = int(universe) if universe else DEFAULT_UNIVERSE
+            seen, ordered = set(), []
+            for video in base:
+                if video in seen:
+                    break
+                seen.add(video)
+                ordered.append(video)
+                if len(ordered) >= want:
+                    break
+            videos = ordered
+        if not videos:
+            raise ValueError("ZipfPathIterator needs a non-empty video "
+                             "universe")
+        videos = list(videos)
+        if universe is not None:
+            universe = min(int(universe), len(videos))
+            if universe < 1:
+                raise ValueError("popularity universe must be >= 1")
+            videos = videos[:universe]
+        self._videos = videos
+        self.s = float(s)
+        self.seed = seed
+        import numpy as np
+        self._probabilities = zipf_probabilities(len(videos), self.s)
+        self._cumulative = np.cumsum(self._probabilities)
+        self._cumulative[-1] = 1.0  # guard float drift at the tail
+
+    def dataset(self):
+        return list(self._videos)
+
+    def __iter__(self):
+        import numpy as np
+        rng = np.random.default_rng(self.seed)
+        videos, cumulative = self._videos, self._cumulative
+        while True:
+            # inverse-CDF draw: O(log U) per request vs rng.choice's
+            # O(U) — the client hot loop runs per arrival
+            yield videos[int(np.searchsorted(cumulative, rng.random(),
+                                             side="right"))]

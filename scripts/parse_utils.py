@@ -56,6 +56,13 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta[key] = int(val)
+        elif line.startswith("Cache:"):
+            # "Cache: hits=H misses=M inserts=I evictions=E
+            #  coalesced=C oversize=O bytes_resident=B" — written only
+            # by cache-enabled runs (rnb_tpu.cache)
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["cache_" + key] = int(val)
         elif line.startswith("Failure reasons:"):
             import json
             meta["failure_reasons"] = json.loads(line.split(":", 1)[1])
@@ -112,6 +119,31 @@ def parse_timing_table(path: str) -> pd.DataFrame:
         df["final_group"] = int(m.group("group"))
         df["final_instance"] = int(m.group("instance"))
     return df
+
+
+def parse_table_trailers(path: str) -> Dict[str, Dict[str, int]]:
+    """``#``-prefixed trailer lines of one timing table, keyed by
+    trailer kind: ``{"faults": {...}, "cache": {...}}`` with integer
+    ``key=value`` fields (non-integer fields like ``reason:x=3`` keep
+    their full token as key). Absent trailers are absent keys."""
+    trailers: Dict[str, Dict[str, int]] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.startswith("#"):
+                continue
+            tokens = line[1:].split()
+            if not tokens:
+                continue
+            fields: Dict[str, int] = {}
+            for token in tokens[1:]:
+                key, sep, val = token.partition("=")
+                if sep:
+                    try:
+                        fields[key] = int(val)
+                    except ValueError:
+                        fields[token] = 0
+            trailers[tokens[0]] = fields
+    return trailers
 
 
 def parse_dead_letters(job_dir: str) -> pd.DataFrame:
@@ -262,3 +294,119 @@ def decompose_latency(df: pd.DataFrame) -> pd.DataFrame:
             continue
         out["gap:%s->%s" % (prv, nxt)] = (df[nxt] - df[prv]) * 1000.0
     return out
+
+
+# -- consistency checking (CLI: parse_utils.py --check <job_dir>) ------
+
+def check_job(job_dir: str) -> List[str]:
+    """Cross-artifact consistency check of one job's log directory:
+    log-meta vs timing tables vs trailers vs dead letters. Returns a
+    list of human-readable problems (empty = consistent)."""
+    problems: List[str] = []
+    try:
+        meta = parse_meta(job_dir)
+    except (OSError, ValueError) as e:
+        return ["log-meta.txt unreadable: %s" % e]
+    if "termination_flag" not in meta:
+        problems.append("log-meta.txt carries no 'Termination flag:'")
+    if "wall_time_s" not in meta:
+        problems.append("log-meta.txt carries no start/end timestamps")
+
+    tables = _timing_tables(job_dir)
+    num_rows = 0
+    table_faults = {"num_failed": 0, "num_shed": 0, "num_retries": 0}
+    cache_hits = cache_tracked = 0
+    saw_cache_trailer = False
+    for path in tables:
+        try:
+            num_rows += len(parse_timing_table(path))
+        except (OSError, ValueError) as e:
+            problems.append("%s unparsable: %s"
+                            % (os.path.basename(path), e))
+            continue
+        trailers = parse_table_trailers(path)
+        for key in table_faults:
+            table_faults[key] += trailers.get("faults", {}).get(key, 0)
+        if "cache" in trailers:
+            saw_cache_trailer = True
+            cache_hits += trailers["cache"].get("num_hits", 0)
+            cache_tracked += trailers["cache"].get("num_tracked", 0)
+    if not tables:
+        problems.append("no timing tables (<device>-group<g>-<i>.txt)")
+
+    # fault accounting: table trailers count only failures observed AT
+    # final-step instances; the meta line is job-wide, so tables can
+    # never exceed it
+    for key in ("num_failed", "num_shed"):
+        if key in meta and table_faults[key] > meta[key]:
+            problems.append(
+                "tables count %s=%d but log-meta says %d"
+                % (key, table_faults[key], meta[key]))
+    letters = parse_dead_letters(job_dir)
+    if "num_failed" in meta and len(letters) > meta["num_failed"]:
+        problems.append("failed-requests.txt has %d rows but log-meta "
+                        "says num_failed=%d"
+                        % (len(letters), meta["num_failed"]))
+
+    # cache accounting: a '# cache' trailer requires the job-wide
+    # 'Cache:' line; completed hits can never exceed loader-side hits
+    if saw_cache_trailer and "cache_hits" not in meta:
+        problems.append("tables carry a '# cache' trailer but log-meta "
+                        "has no 'Cache:' line")
+    if "cache_hits" in meta:
+        # hits recorded on completed cards at the final step are a
+        # subset of the loader's lookup hits (some hit requests may
+        # still be shed/failed downstream)
+        if cache_hits > meta["cache_hits"] + meta.get("cache_coalesced",
+                                                      0):
+            problems.append(
+                "tables count %d completed cache hits but log-meta "
+                "records only %d lookup hits (+%d coalesced)"
+                % (cache_hits, meta["cache_hits"],
+                   meta.get("cache_coalesced", 0)))
+        if cache_tracked > num_rows:
+            problems.append("cache trailer tracks %d completions but "
+                            "tables hold %d rows"
+                            % (cache_tracked, num_rows))
+        if meta.get("cache_inserts", 0) > meta.get("cache_misses", 0):
+            problems.append("cache_inserts=%d exceeds cache_misses=%d "
+                            "(inserts happen only after a miss decoded)"
+                            % (meta["cache_inserts"],
+                               meta["cache_misses"]))
+        if meta.get("cache_bytes_resident", 0) < 0:
+            problems.append("negative cache_bytes_resident")
+    return problems
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="Benchmark log parsing and consistency checking")
+    parser.add_argument("job_dirs", nargs="+",
+                        help="logs/<job_id> directories to inspect")
+    parser.add_argument("--check", action="store_true",
+                        help="cross-check log-meta vs timing tables vs "
+                             "trailers; non-zero exit on inconsistency")
+    args = parser.parse_args(argv)
+    status = 0
+    for job_dir in args.job_dirs:
+        if args.check:
+            problems = check_job(job_dir)
+            if problems:
+                status = 1
+                print("%s: INCONSISTENT" % job_dir)
+                for problem in problems:
+                    print("  - %s" % problem)
+            else:
+                print("%s: OK" % job_dir)
+        else:
+            meta, df = get_data(job_dir)
+            print("%s: %d requests" % (job_dir, len(df)))
+            for key in sorted(meta):
+                print("  %s = %r" % (key, meta[key]))
+    return status
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

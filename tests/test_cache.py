@@ -1,0 +1,469 @@
+"""Device-resident decoded-clip cache + in-flight coalescing
+(rnb_tpu.cache): lookup/eviction accounting, hit/miss bit-identical
+serving through both loaders, coalesced TimeCard fan-out, and the
+fault interaction (a failed decode is never inserted).
+
+The fast tests here are the tier-1 unit suite for the subsystem; the
+end-to-end Zipf+cache pipeline run is ``slow``-marked.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rnb_tpu.cache import (ClipCache, InflightTable, aggregate_snapshots,
+                           content_key)
+from rnb_tpu.telemetry import TimeCard, TimeCardList
+
+
+def _entry(mb: float, fill: int = 0) -> np.ndarray:
+    return np.full((int(mb * (1 << 20)),), fill, dtype=np.uint8)
+
+
+# -- ClipCache unit logic (no jax needed: any .nbytes array works) ----
+
+def test_lookup_miss_then_hit_counts():
+    cache = ClipCache(1)
+    key = ("v", (-1, -1), "cfg")
+    assert cache.lookup(key) is None
+    assert cache.insert_device(key, _entry(0.25), 3)
+    entry = cache.lookup(key)
+    assert entry is not None and entry.valid == 3
+    snap = cache.snapshot()
+    assert (snap["hits"], snap["misses"], snap["inserts"]) == (1, 1, 1)
+    assert snap["bytes_resident"] == entry.nbytes
+    assert snap["entries"] == 1
+
+
+def test_lru_eviction_stays_within_budget():
+    cache = ClipCache(1)  # 1 MiB budget
+    for i in range(5):
+        assert cache.insert_device(("v%d" % i, (-1, -1), "c"),
+                                   _entry(0.3), 1)
+    snap = cache.snapshot()
+    assert snap["bytes_resident"] <= cache.capacity_bytes
+    assert snap["entries"] == 3
+    assert snap["evictions"] == 2
+    # LRU order: the two oldest are gone, the three newest resident
+    assert cache.lookup(("v0", (-1, -1), "c")) is None
+    assert cache.lookup(("v4", (-1, -1), "c")) is not None
+
+
+def test_lookup_refreshes_recency():
+    cache = ClipCache(1)
+    for i in range(3):
+        cache.insert_device(("v%d" % i, (-1, -1), "c"), _entry(0.3), 1)
+    assert cache.lookup(("v0", (-1, -1), "c")) is not None  # touch LRU
+    cache.insert_device(("v3", (-1, -1), "c"), _entry(0.3), 1)
+    # v1 (now the least recent) was evicted, the touched v0 survived
+    assert cache.lookup(("v0", (-1, -1), "c")) is not None
+    assert cache.lookup(("v1", (-1, -1), "c")) is None
+
+
+def test_oversize_entry_skipped_not_inserted():
+    cache = ClipCache(0.5)
+    assert not cache.insert_device(("big", (-1, -1), "c"), _entry(1.0), 1)
+    snap = cache.snapshot()
+    assert snap["oversize"] == 1
+    assert snap["entries"] == 0 and snap["bytes_resident"] == 0
+
+
+def test_duplicate_insert_is_noop():
+    cache = ClipCache(1)
+    key = ("v", (-1, -1), "c")
+    assert cache.insert_device(key, _entry(0.1, fill=1), 2)
+    assert not cache.insert_device(key, _entry(0.1, fill=9), 5)
+    entry = cache.lookup(key)
+    assert entry.valid == 2 and entry.batch[0] == 1  # first writer wins
+    assert cache.snapshot()["inserts"] == 1
+
+
+def test_zero_budget_rejected():
+    with pytest.raises(ValueError):
+        ClipCache(0)
+
+
+def test_content_key_tracks_file_identity(tmp_path):
+    path = str(tmp_path / "v.y4m")
+    with open(path, "wb") as f:
+        f.write(b"AAAA")
+    k1 = content_key(path, "cfg")
+    assert content_key(path, "cfg") == k1
+    with open(path, "wb") as f:
+        f.write(b"BBBBBBBB")  # different size (and mtime)
+    assert content_key(path, "cfg") != k1
+    # config fingerprint is part of the key
+    assert content_key(path, "other-cfg") != content_key(path, "cfg")
+    # non-file ids get the constant signature (content is procedural)
+    assert content_key("synth://a", "cfg") == content_key("synth://a",
+                                                          "cfg")
+
+
+def test_aggregate_snapshots_sums():
+    a = ClipCache(1)
+    a.insert_device(("x", (-1, -1), "c"), _entry(0.1), 1)
+    a.lookup(("x", (-1, -1), "c"))
+    b = ClipCache(1)
+    b.lookup(("y", (-1, -1), "c"))
+    b.note_coalesced(2)
+    total = aggregate_snapshots([a.snapshot(), b.snapshot()])
+    assert total["hits"] == 1 and total["misses"] == 1
+    assert total["inserts"] == 1 and total["coalesced"] == 2
+
+
+def test_inflight_table_basic():
+    table = InflightTable()
+    table.put("k", "rec")
+    assert table.get("k") == "rec"
+    table.pop("k")
+    table.pop("k")      # idempotent
+    table.pop(None)     # no-op
+    assert table.get("k") is None and len(table) == 0
+
+
+# -- loader integration (8-virtual-device CPU backend, conftest) ------
+
+def _plain_loader(**kw):
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DLoader
+    kw.setdefault("num_warmups", 0)
+    kw.setdefault("num_clips_population", [2])
+    kw.setdefault("weights", [1])
+    kw.setdefault("consecutive_frames", 2)
+    return R2P1DLoader(jax.devices()[0], **kw)
+
+
+def _fusing_loader(**kw):
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader
+    kw.setdefault("num_warmups", 0)
+    kw.setdefault("num_clips_population", [1])
+    kw.setdefault("weights", [1])
+    kw.setdefault("consecutive_frames", 2)
+    kw.setdefault("max_hold_ms", 10000.0)
+    kw.setdefault("depth", 50)
+    return R2P1DFusingLoader(jax.devices()[0], **kw)
+
+
+def test_plain_loader_hit_is_bit_identical_and_stamped():
+    loader = _plain_loader(cache_mb=16)
+    video = "synth://kinetics/video-0042"
+    tc_miss, tc_hit = TimeCard(0), TimeCard(1)
+    (pb_miss,), _, _ = loader(None, video, tc_miss)
+    (pb_hit,), _, _ = loader(None, video, tc_hit)
+    assert tc_miss.cache_hit is False and tc_hit.cache_hit is True
+    assert pb_hit.valid == pb_miss.valid == tc_hit.num_clips
+    np.testing.assert_array_equal(np.asarray(pb_miss.data),
+                                  np.asarray(pb_hit.data))
+    snap = loader.cache.snapshot()
+    assert snap["hits"] == 1 and snap["misses"] == 1
+    assert snap["inserts"] == 1
+
+
+def test_hit_and_miss_logits_bit_identical_through_network():
+    """The golden-logit acceptance check at stage level: the cached
+    device batch feeds the identical jitted preprocess+network path a
+    miss feeds, so per-request logits match bit-for-bit."""
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DRunner
+    loader = _plain_loader(cache_mb=16)
+    net = R2P1DRunner(jax.devices()[0], start_index=1, end_index=5,
+                      num_classes=8, layer_sizes=[1, 1, 1, 1],
+                      max_rows=2, consecutive_frames=2, num_warmups=0)
+    video = "synth://kinetics/video-0007"
+    (pb_miss,), _, _ = loader(None, video, TimeCard(0))
+    (logits_miss,), _, _ = net((pb_miss,), None, TimeCard(0))
+    tc = TimeCard(1)
+    (pb_hit,), _, _ = loader(None, video, tc)
+    assert tc.cache_hit is True
+    (logits_hit,), _, _ = net((pb_hit,), None, tc)
+    np.testing.assert_array_equal(np.asarray(logits_miss.data),
+                                  np.asarray(logits_hit.data))
+
+
+def test_plain_loader_eviction_under_forced_overflow():
+    # max_clips=2 so the padded bucket is 2x2x112x112x3 = ~147 KiB;
+    # a 0.2 MiB budget holds exactly one entry
+    loader = _plain_loader(cache_mb=0.2, max_clips=2)
+    for i in range(4):
+        loader(None, "synth://kinetics/video-%04d" % i, TimeCard(i))
+    snap = loader.cache.snapshot()
+    assert snap["bytes_resident"] <= loader.cache.capacity_bytes
+    assert snap["evictions"] == 3 and snap["entries"] == 1
+
+
+def test_prefetch_submit_coalesces_inflight_duplicates():
+    loader = _plain_loader(cache_mb=16, prefetch=4)
+    video = "synth://kinetics/video-0005"
+    tc_lead, tc_follow = TimeCard(0), TimeCard(1)
+    lead = loader.submit(video, tc_lead)
+    follow = loader.submit(video, tc_follow)
+    assert follow.leader is lead
+    assert tc_follow.cache_coalesced is True
+    assert tc_follow.num_clips == tc_lead.num_clips
+    out_lead = loader.complete(lead, video, tc_lead)
+    out_follow = loader.complete(follow, video, tc_follow)
+    np.testing.assert_array_equal(np.asarray(out_lead[0][0].data),
+                                  np.asarray(out_follow[0][0].data))
+    snap = loader.cache.snapshot()
+    assert snap["coalesced"] == 1
+    assert snap["inserts"] == 1  # only the leader inserted
+    # the in-flight window is drained and a fresh request now hits
+    tc3 = TimeCard(2)
+    h3 = loader.submit(video, tc3)
+    assert h3.cached is not None and tc3.cache_hit is True
+
+
+def test_fusing_loader_hit_emits_immediately_bit_identical():
+    loader = _fusing_loader(cache_mb=16, fuse=3)
+    video = "synth://kinetics/video-0009"
+    emitted = []
+    out = loader(None, video, TimeCard(0))
+    if out[2] is not None:
+        emitted.append(out)
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        emitted.append(out)
+    assert len(emitted) == 1
+    (pb_miss,), _, cards_miss = emitted[0]
+    assert len(cards_miss) == 1
+    # second request for the same video: an immediate standalone hit
+    tc = TimeCard(1)
+    tensors, _, cards = loader(None, video, tc)
+    assert cards is not None and isinstance(cards, TimeCardList)
+    assert tc.cache_hit is True
+    (pb_hit,) = tensors
+    assert pb_hit.valid == pb_miss.valid
+    np.testing.assert_array_equal(np.asarray(pb_miss.data),
+                                  np.asarray(pb_hit.data))
+    assert loader.flush() is None  # the hit left no pending state
+
+
+def test_fusing_loader_coalesces_concurrent_same_key_requests():
+    """Two concurrent requests for one video share one decode and ride
+    one fused emission: every card is stamped via the TimeCardList
+    fan-out (the machinery a follower reuses instead of re-decoding)."""
+    loader = _fusing_loader(cache_mb=16, fuse=10)
+    gate = threading.Event()
+    real_decode = loader._decode_sync
+
+    def gated_decode(decoder, video, starts):
+        gate.wait(10.0)
+        return real_decode(decoder, video, starts)
+
+    loader._decode_sync = gated_decode
+    video = "synth://kinetics/video-0011"
+    tc_lead, tc_follow = TimeCard(0), TimeCard(1)
+    out = loader(None, video, tc_lead)
+    assert out[2] is None          # decode gated: nothing emitted
+    out = loader(None, video, tc_follow)
+    assert out[2] is None          # coalesced, no second decode
+    assert tc_follow.cache_coalesced is True
+    assert loader.cache.snapshot()["coalesced"] == 1
+    assert len(loader._inflight) == 1  # ONE decode for two requests
+    gate.set()
+    out = loader.flush()
+    assert out is not None
+    (pb,), _, cards = out
+    assert isinstance(cards, TimeCardList) and len(cards) == 2
+    assert {tc.id for tc in cards.time_cards} == {0, 1}
+    assert pb.valid == tc_lead.num_clips  # rows appear ONCE
+    assert loader.flush() is None
+    # the shared decode was inserted; a third request hits
+    tc3 = TimeCard(2)
+    tensors, _, cards3 = loader(None, video, tc3)
+    assert cards3 is not None and tc3.cache_hit is True
+
+
+def test_failed_decode_never_inserted_and_fails_followers():
+    """PR-1 fault composition: a decode failing with a classified
+    error inside fused assembly parks every rider (leader + coalesced
+    followers) on the take_failed() queue and never touches the
+    cache."""
+    from rnb_tpu.faults import CorruptVideoError
+    loader = _fusing_loader(cache_mb=16, fuse=10)
+    calls = {"n": 0}
+    gate = threading.Event()
+
+    def broken_decode(decoder, video, starts):
+        calls["n"] += 1
+        gate.wait(10.0)  # hold the decode in flight so a follower can
+        raise CorruptVideoError("injected corrupt payload")  # coalesce
+
+    loader._decode_sync = broken_decode
+    video = "synth://kinetics/video-0013"
+    tc_lead, tc_follow = TimeCard(0), TimeCard(1)
+    loader(None, video, tc_lead)
+    loader(None, video, tc_follow)
+    assert tc_follow.cache_coalesced is True
+    gate.set()
+    assert loader.flush() is None  # the whole batch failed
+    failed = loader.take_failed()
+    assert sorted(tc.id for tc, _ in failed) == [0, 1]
+    assert all(reason == "corrupt-video" for _, reason in failed)
+    snap = loader.cache.snapshot()
+    assert snap["inserts"] == 0 and snap["entries"] == 0
+    # the coalescing window is closed: the next request re-decodes
+    # (fresh miss) rather than parking on a dead record
+    before = calls["n"]
+    loader(None, video, TimeCard(2))
+    loader.flush()
+    loader.take_failed()
+    assert calls["n"] > before
+    assert snap["hits"] == 0
+
+
+def test_cache_composes_with_row_buckets():
+    loader = _fusing_loader(cache_mb=16, fuse=3, max_clips=15,
+                            row_buckets=[6, 15],
+                            num_clips_population=[2], weights=[1])
+    video = "synth://kinetics/video-0021"
+    emitted = []
+    loader(None, video, TimeCard(0))
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        emitted.append(out)
+    # 2 valid rows pad to the 6-bucket on the miss...
+    assert emitted[0][0][0].data.shape[0] == 6
+    tensors, _, cards = loader(None, video, TimeCard(1))
+    # ...and the hit serves the identical bucket shape
+    assert tensors[0].data.shape[0] == 6
+    np.testing.assert_array_equal(np.asarray(emitted[0][0][0].data),
+                                  np.asarray(tensors[0].data))
+
+
+# -- end-to-end: Zipf workload through the full pipeline --------------
+
+@pytest.mark.slow
+def test_zipf_cache_pipeline_end_to_end(tmp_path, monkeypatch):
+    """Acceptance scenario: a seeded Zipf workload over a real y4m
+    dataset with the cache enabled completes on the CPU backend with
+    hit-rate > 0, stamps every coalesced/hit request's completed
+    TimeCard, and reports consistent cache stats in BenchmarkResult,
+    log-meta.txt and `parse_utils --check`."""
+    import sys
+
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.control import TerminationFlag
+    from rnb_tpu.decode import write_y4m
+
+    data_root = str(tmp_path / "data")
+    label = os.path.join(data_root, "label0")
+    os.makedirs(label)
+    rng = np.random.default_rng(3)
+    for i in range(6):
+        write_y4m(os.path.join(label, "v%02d.y4m" % i),
+                  rng.integers(0, 256, (6, 16, 16, 3), dtype=np.uint8),
+                  colorspace="420")
+    monkeypatch.setenv("RNB_TPU_DATA_ROOT", data_root)
+
+    cfg = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "popularity": {"dist": "zipf", "s": 1.3, "universe": 4},
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DFusingLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 30, "fuse": 3, "depth": 2,
+             "max_clips": 2, "consecutive_frames": 2,
+             "num_clips_population": [1, 2],
+             "weights": [1, 1], "num_warmups": 0, "cache_mb": 32},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [1], "in_queue": 0}],
+             "start_index": 1, "end_index": 5, "num_classes": 8,
+             "layer_sizes": [1, 1, 1, 1], "max_rows": 2,
+             "consecutive_frames": 2, "num_warmups": 1},
+        ],
+    }
+    cfg_path = os.path.join(str(tmp_path), "pipeline.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+
+    res = run_benchmark(cfg_path, mean_interval_ms=0, num_videos=60,
+                        queue_size=200, log_base=str(tmp_path / "logs"),
+                        print_progress=False, seed=11)
+    assert res.termination_flag == \
+        TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    assert res.num_completed == 60
+    # 60 requests over a 4-video Zipf universe: the cache must serve
+    # most of them
+    assert res.cache_hits > 0
+    assert res.cache_misses >= 4
+    # every request is exactly one lookup; coalesced followers are the
+    # subset of misses that shared an in-flight decode
+    assert res.cache_hits + res.cache_misses == 60
+    assert res.cache_coalesced <= res.cache_misses
+    assert res.cache_inserts <= res.cache_misses
+    assert res.cache_bytes_resident > 0
+
+    # log-meta carries the same counters
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert ("Cache: hits=%d misses=%d inserts=%d evictions=%d "
+            "coalesced=%d" % (res.cache_hits, res.cache_misses,
+                              res.cache_inserts, res.cache_evictions,
+                              res.cache_coalesced)) in meta_text
+
+    # every request — hits, misses and coalesced followers — received
+    # a completed, cache-stamped TimeCard in the final table
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import parse_utils
+    meta, df = parse_utils.get_data(res.log_dir)
+    assert meta["cache_hits"] == res.cache_hits
+    assert meta["cache_coalesced"] == res.cache_coalesced
+    assert len(df) == 60
+    report = [f for f in os.listdir(res.log_dir) if "group" in f][0]
+    trailers = parse_utils.parse_table_trailers(
+        os.path.join(res.log_dir, report))
+    assert trailers["cache"]["num_tracked"] == 60
+    assert trailers["cache"]["num_hits"] > 0
+
+    # the consistency checker agrees end-to-end
+    assert parse_utils.check_job(res.log_dir) == []
+    assert parse_utils.main(["--check", res.log_dir]) == 0
+
+
+@pytest.mark.slow
+def test_zipf_same_seed_same_results(tmp_path, monkeypatch):
+    """Determinism of the benchmark cell: same seed => identical
+    request stream => identical cache accounting."""
+    from rnb_tpu.benchmark import run_benchmark
+
+    cfg = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "popularity": {"dist": "zipf", "s": 1.0, "universe": 8},
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 20, "max_clips": 2,
+             "consecutive_frames": 2, "num_clips_population": [2],
+             "weights": [1], "num_warmups": 0, "cache_mb": 32},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [1], "in_queue": 0}],
+             "start_index": 1, "end_index": 5, "num_classes": 8,
+             "layer_sizes": [1, 1, 1, 1], "max_rows": 2,
+             "consecutive_frames": 2, "num_warmups": 1},
+        ],
+    }
+    cfg_path = os.path.join(str(tmp_path), "pipeline.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    results = []
+    for run in range(2):
+        res = run_benchmark(cfg_path, mean_interval_ms=0, num_videos=30,
+                            queue_size=100,
+                            log_base=str(tmp_path / ("logs%d" % run)),
+                            print_progress=False, seed=5)
+        results.append((res.cache_hits, res.cache_misses,
+                        res.cache_inserts, res.num_completed))
+    assert results[0] == results[1]
+    assert results[0][0] > 0

@@ -523,7 +523,7 @@ def test_fusing_loader_transient_retry(monkeypatch):
     dead-lettered immediately."""
     import jax
 
-    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader
+    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader, _FuseRecord
     from rnb_tpu.telemetry import TimeCard
 
     loader = R2P1DFusingLoader(jax.devices()[0], max_clips=2,
@@ -535,13 +535,15 @@ def test_fusing_loader_transient_retry(monkeypatch):
     class BoomHandle:
         n = 1
         out = None
+        error = None
 
         def wait(self, v):
             raise TransientDecodeError("rc -1")
 
     # no budget: transient is dead-lettered with the exhausted prefix
     loader.fault_retry_budget = (0, 0.0)
-    assert loader._wait_contained(BoomHandle(), video, tc) is False
+    assert loader._wait_contained(
+        _FuseRecord(BoomHandle(), video, tc)) is False
     ((failed_tc, reason),) = loader.take_failed()
     assert failed_tc is tc
     assert reason == "retries-exhausted:decode-io"
@@ -550,7 +552,7 @@ def test_fusing_loader_transient_retry(monkeypatch):
     # with budget: the synchronous re-decode succeeds on retry
     loader.fault_retry_budget = (2, 0.0)
     handle = BoomHandle()
-    assert loader._wait_contained(handle, video, tc) is True
+    assert loader._wait_contained(_FuseRecord(handle, video, tc)) is True
     assert handle.out is not None and handle.out.shape[0] >= 1
     assert loader.take_retries() == 1
     assert loader.take_failed() == []

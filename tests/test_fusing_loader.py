@@ -336,10 +336,11 @@ def test_flush_take_hits_exact_buckets(tmp_path):
         loader = _loader(fuse=fuse, max_hold_ms=1e9, depth=100,
                          max_clips=36, row_buckets=[6, 15, 24, 36],
                          num_clips_population=[3], weights=[1])
+        from rnb_tpu.models.r2p1d.model import _FuseRecord
         for i, p in enumerate(paths):
             tc = TimeCard(i)
             handle = loader.submit(p, tc)
-            loader._inflight.append((handle, p, tc))
+            loader._inflight.append(_FuseRecord(handle, p, tc))
         got = []
         while True:
             out = loader.flush()

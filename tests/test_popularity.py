@@ -1,0 +1,157 @@
+"""Zipf popularity sampling (rnb_tpu.video_path_provider) and the
+``popularity`` config key: seeded determinism, the s=0 uniform
+degenerate case, universe clamping, and client wiring."""
+
+import itertools
+import queue
+import threading
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from rnb_tpu.config import ConfigError, parse_config
+from rnb_tpu.video_path_provider import (DEFAULT_UNIVERSE,
+                                         VideoPathIterator,
+                                         ZipfPathIterator,
+                                         zipf_probabilities)
+
+
+class _TenVideos(VideoPathIterator):
+    def __init__(self, n=10):
+        super().__init__()
+        self._videos = ["video-%02d" % i for i in range(n)]
+
+    def dataset(self):
+        return list(self._videos)
+
+    def __iter__(self):
+        return itertools.cycle(self._videos)
+
+
+def _draw(it, n):
+    return list(itertools.islice(iter(it), n))
+
+
+def test_same_seed_identical_request_sequence():
+    a = _draw(ZipfPathIterator(_TenVideos(), s=1.2, seed=42), 200)
+    b = _draw(ZipfPathIterator(_TenVideos(), s=1.2, seed=42), 200)
+    assert a == b
+    c = _draw(ZipfPathIterator(_TenVideos(), s=1.2, seed=43), 200)
+    assert a != c  # a different seed reorders the stream
+
+
+def test_s_zero_degenerates_to_uniform():
+    probs = zipf_probabilities(8, 0.0)
+    np.testing.assert_allclose(probs, np.full(8, 1.0 / 8))
+    # and the drawn stream covers the universe ~evenly
+    counts = Counter(_draw(ZipfPathIterator(_TenVideos(), s=0.0,
+                                            seed=1), 5000))
+    assert len(counts) == 10
+    assert max(counts.values()) < 2 * min(counts.values())
+
+
+def test_positive_s_skews_toward_head_ranks():
+    counts = Counter(_draw(ZipfPathIterator(_TenVideos(), s=1.5,
+                                            seed=7), 2000))
+    assert counts["video-00"] > counts.get("video-09", 0) * 5
+    # rank assignment is the dataset order
+    probs = zipf_probabilities(10, 1.5)
+    assert probs[0] == max(probs) and probs[-1] == min(probs)
+
+
+def test_universe_clamps_to_dataset_size():
+    z = ZipfPathIterator(_TenVideos(), s=1.0, universe=999, seed=0)
+    assert len(z.dataset()) == 10
+    z = ZipfPathIterator(_TenVideos(), s=1.0, universe=3, seed=0)
+    assert z.dataset() == ["video-00", "video-01", "video-02"]
+    assert set(_draw(z, 300)) <= set(z.dataset())
+
+
+def test_fallback_universe_from_cycling_iterator():
+    # a base iterator without dataset(): the wrapper materializes the
+    # first distinct items from the endless cycle
+    z = ZipfPathIterator(itertools.cycle(["a", "b", "c"]), s=1.0,
+                         universe=2, seed=0)
+    assert z.dataset() == ["a", "b"]
+    z = ZipfPathIterator(itertools.cycle(["a", "b", "c"]), s=1.0, seed=0)
+    assert z.dataset() == ["a", "b", "c"]  # cycle detected < DEFAULT
+    assert len(z.dataset()) <= DEFAULT_UNIVERSE
+
+
+def test_invalid_parameters_rejected():
+    with pytest.raises(ValueError):
+        zipf_probabilities(0, 1.0)
+    with pytest.raises(ValueError):
+        zipf_probabilities(5, -0.5)
+    with pytest.raises(ValueError):
+        ZipfPathIterator(_TenVideos(0), s=1.0)  # empty universe
+
+
+# -- config schema ----------------------------------------------------
+
+def _cfg(popularity):
+    return {
+        "video_path_iterator": "tests.test_popularity._TenVideos",
+        "popularity": popularity,
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0]}]},
+        ],
+    }
+
+
+def test_config_accepts_and_carries_popularity():
+    cfg = parse_config(_cfg({"dist": "zipf", "s": 1.1, "universe": 8}))
+    assert cfg.popularity == {"dist": "zipf", "s": 1.1, "universe": 8}
+    assert parse_config(_cfg({"s": 0})).popularity == {"s": 0}
+    # absent key stays None (no popularity wrapping)
+    base = _cfg({})
+    del base["popularity"]
+    assert parse_config(base).popularity is None
+
+
+def test_config_rejects_malformed_popularity():
+    for bad in ("zipf",                     # not an object
+                {"dist": "pareto"},         # unsupported distribution
+                {"s": -1},                  # negative skew
+                {"s": True},                # boolean masquerading
+                {"universe": 0},            # non-positive universe
+                {"universe": 2.5},          # non-integer universe
+                {"typo": 1}):               # unknown key
+        with pytest.raises(ConfigError):
+            parse_config(_cfg(bad))
+
+
+# -- client wiring ----------------------------------------------------
+
+def test_client_wraps_iterator_with_popularity():
+    from rnb_tpu.client import bulk_client
+    from rnb_tpu.control import TerminationState
+
+    def run(popularity, seed):
+        q = queue.Queue(maxsize=1000)
+        termination = TerminationState()
+        sta = threading.Barrier(1)
+        fin = threading.Barrier(1)
+        bulk_client("tests.test_popularity._TenVideos", q, 50,
+                    termination, sta, fin, seed=seed, num_markers=1,
+                    popularity=popularity)
+        paths = []
+        while True:
+            item = q.get_nowait()
+            if item is None:
+                break
+            paths.append(item[1])
+        return paths
+
+    pop = {"dist": "zipf", "s": 1.4, "universe": 4}
+    a = run(pop, seed=9)
+    b = run(pop, seed=9)
+    assert a == b                      # seeded: identical stream
+    assert len(a) == 50
+    assert set(a) <= {"video-%02d" % i for i in range(4)}  # universe
+    counts = Counter(a)
+    assert counts["video-00"] == max(counts.values())  # head-heavy
+    plain = run(None, seed=9)
+    assert plain[:10] == ["video-%02d" % i for i in range(10)]  # cycle

@@ -77,6 +77,53 @@ def test_batcher_emits_early_when_request_would_overflow():
     assert len(card) == 2
 
 
+def test_batcher_input_shape_follows_constructor_args():
+    # regression: input_shape() used to hardcode the flagship
+    # (MAX_ROWS, 8, 112, 112, 3) shape regardless of the
+    # shapes/max_rows/consecutive_frames/frame_hw the instance was
+    # built with, so declared-vs-actual payload validation was wrong
+    # for every non-flagship topology
+    b = Batcher(device=None, batch=2, max_rows=4, consecutive_frames=2,
+                frame_hw=16)
+    assert b.input_shape() == ((4, 2, 16, 16, 3),)
+    assert b.input_shape() == b.output_shape_for(
+        max_rows=4, consecutive_frames=2, frame_hw=16)
+    b = Batcher(device=None, batch=2, shapes=[[6, 3], [6, 5]])
+    assert b.input_shape() == ((6, 3), (6, 5))
+    # default construction keeps the flagship shape
+    assert Batcher(device=None, batch=2).input_shape() == \
+        ((15, 8, 112, 112, 3),)
+
+
+def test_batcher_early_emission_non_flagship_window():
+    # regression (previously untested): a MID-SIZED request closing a
+    # pending window on a non-flagship declared shape — the pending
+    # batch must emit with only its own cards and the displaced
+    # request must seed the next window intact
+    b = Batcher(device=None, batch=3, shapes=[[4, 2]])
+
+    def req(rows, fill):
+        return (PaddedBatch.from_rows(
+            np.full((rows, 2), fill, dtype=np.float32), max_rows=4),)
+
+    assert b(req(2, 1.0), None, TimeCard(0)) == (None, None, None)
+    # 2 pending + 3 incoming > 4 declared: early emission fires
+    tensors, non_tensors, card = b(req(3, 2.0), None, TimeCard(1))
+    assert non_tensors is None
+    assert isinstance(card, TimeCardList) and len(card) == 1
+    assert tensors[0].valid == 2
+    assert tensors[0].data.shape == (4, 2)
+    np.testing.assert_array_equal(tensors[0].valid_data()[:, 0],
+                                  [1.0, 1.0])
+    # the displaced mid-sized request is the next window's seed
+    flushed = b.flush()
+    assert flushed is not None
+    assert flushed[0][0].valid == 3
+    assert len(flushed[2]) == 1
+    np.testing.assert_array_equal(flushed[0][0].valid_data()[:, 0],
+                                  [2.0, 2.0, 2.0])
+
+
 def test_batcher_rejects_single_oversized_request():
     # a lone request beyond the DECLARED capacity is a topology error
     b = Batcher(device=None, batch=2, shapes=[[4, 3, 8, 112, 112]])
