@@ -1,0 +1,26 @@
+# Developer entry points. The native decoder has its own Makefile
+# (native/Makefile, `make native`); everything here is pure Python.
+
+PYTHON ?= python
+
+.PHONY: lint test native stamps
+
+# Static analysis: pipeline graph checker over every shipped config,
+# hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
+# device, no dataset. Rule catalog: README.md "Static analysis".
+lint:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/rnb_lint.py
+
+# Tier-1 gate (same selection ROADMAP.md pins): fast tests on the
+# forced 8-virtual-device CPU backend.
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider
+
+# Generated telemetry-schema reference (the registries rnb-lint
+# enforces).
+stamps:
+	$(PYTHON) scripts/parse_utils.py --stamps
+
+native:
+	$(MAKE) -C native

@@ -1,0 +1,30 @@
+"""rnb-lint: static pipeline/config/telemetry analysis.
+
+Three analyzer families, all runnable with no JAX device and no
+dataset (``scripts/rnb_lint.py`` is the CLI; a tier-1 pytest runs them
+over the repo and every shipped config):
+
+* :mod:`rnb_tpu.analysis.graph` — pipeline graph checker: resolves
+  every stage class named by a config and propagates declared
+  PaddedBatch max-shape/dtype/row-bucket metadata step-to-step,
+  rejecting shape-incompatible wiring, selector-arity violations,
+  unconsumed config keys and invalid cache settings before any device
+  is touched.
+* :mod:`rnb_tpu.analysis.hotpath` — AST lint over the package: flags
+  host-sync calls inside jitted regions, imports/``device_put`` on
+  per-request paths, nondeterminism in fault-injection code, and
+  ring-slot writes that precede the shed decision.
+* :mod:`rnb_tpu.analysis.schema` — telemetry schema checker: extracts
+  every TimeCard stamp, log-meta line, table trailer and
+  BenchmarkResult counter written anywhere in the tree and
+  cross-checks them against the declared registries in
+  :mod:`rnb_tpu.telemetry` and against what
+  ``scripts/parse_utils.py`` parses.
+
+Findings carry ``file:line``, a rule id and a stable anchor;
+intentional exceptions live in the checked-in ``rnb-lint-baseline.txt``
+with a one-line justification (:mod:`rnb_tpu.analysis.findings`).
+"""
+
+from rnb_tpu.analysis.findings import (Baseline, Finding,  # noqa: F401
+                                       apply_baseline, format_findings)

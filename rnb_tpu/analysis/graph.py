@@ -1,0 +1,305 @@
+"""Static pipeline graph checker: reject broken configs pre-launch.
+
+Loads any pipeline config (shipped or user), resolves every class it
+names, and propagates the stages' *declared* PaddedBatch metadata —
+max shapes (``output_shape_for`` / ``input_shape_for``), dtypes
+(``output_dtype_for`` / ``input_dtype_for``) and row-bucket sets —
+step-to-step along the queue wiring, with no JAX device, no dataset
+and no stage construction. The compile-before-run discipline of
+full-program TPU compilation, applied to pipeline wiring: a config
+that would abort (or silently recompile) ten minutes into a TPU run
+is rejected in milliseconds instead.
+
+Rules
+-----
+* ``RNB-G001`` config-parse: the config fails schema validation
+  (rnb_tpu.config) — covers queue wiring, fault-plan step ranges,
+  popularity keys, segment/ring arithmetic.
+* ``RNB-G002`` unresolvable-class: a ``model`` /
+  ``video_path_iterator`` / ``queue_selector`` class path does not
+  import or the module lacks the class.
+* ``RNB-G003`` shape-mismatch: a producer group's declared (and
+  segment-shrunk) output shapes cannot feed a wired consumer group's
+  declared input shapes (tensor count, trailing dims, or a row axis
+  exceeding the consumer's capacity).
+* ``RNB-G004`` selector-arity: a group's queue selector rejects its
+  out-queue count (e.g. LargeSmallSelector on != 2 queues).
+* ``RNB-G005`` unconsumed-config-key: a step/group extra key is not a
+  named constructor parameter of the stage class (its MRO union plus
+  declared ``FORWARDS_CONFIG_TO`` targets) — the open kwargs
+  passthrough would silently swallow the typo.
+* ``RNB-G006`` bucket-mismatch: the row-count set a producer group can
+  emit is not covered by the consumer's warmed bucket set — every
+  uncovered bucket is a silent XLA recompile inside the measured
+  window. Consumers with ``REPACKS_ROWS`` (Batcher) accept any
+  upstream buckets and are skipped.
+* ``RNB-G007`` invalid-cache-mb: a ``cache_mb`` value the stage would
+  reject at construction (non-numeric or negative; 0 disables).
+* ``RNB-G008`` dtype-mismatch: producer output dtype and consumer
+  input dtype are both declared and differ (e.g. a yuv420 loader wired
+  into an rgb network stage).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+from typing import Any, Dict, List, Optional
+
+from rnb_tpu.analysis.findings import Finding
+from rnb_tpu.config import ConfigError, load_config
+from rnb_tpu.control import get_segmented_shapes
+from rnb_tpu.stage import normalize_row_buckets
+from rnb_tpu.utils.class_utils import load_class
+
+
+def _rel(path: str, root: str) -> str:
+    """Repo-relative finding path — the stable half of the baseline
+    key; paths outside ``root`` stay absolute rather than dotted."""
+    rel = os.path.relpath(path, root)
+    return path if rel.startswith("..") else rel.replace(os.sep, "/")
+
+
+def _resolve(class_path: str, rel: str, anchor: str,
+             findings: List[Finding]):
+    """load_class with an RNB-G002 finding instead of an exception."""
+    try:
+        return load_class(class_path)
+    except Exception as e:
+        findings.append(Finding(
+            "RNB-G002", rel, 0, anchor,
+            "cannot resolve class %r: %s" % (class_path, e)))
+        return None
+
+
+@functools.lru_cache(maxsize=None)
+def consumed_config_keys(cls) -> frozenset:
+    """Named constructor parameters a stage class actually consumes:
+    the union over its MRO plus any classes it declares forwarding its
+    open kwargs to (``FORWARDS_CONFIG_TO``)."""
+    keys: set = set()
+    stack = [cls]
+    seen = set()
+    while stack:
+        c = stack.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        for base in getattr(c, "__mro__", ()):
+            init = base.__dict__.get("__init__")
+            if init is None:
+                continue
+            try:
+                sig = inspect.signature(init)
+            except (TypeError, ValueError):
+                continue
+            for name, param in sig.parameters.items():
+                if param.kind in (param.POSITIONAL_OR_KEYWORD,
+                                  param.KEYWORD_ONLY):
+                    keys.add(name)
+        stack.extend(getattr(c, "FORWARDS_CONFIG_TO", ()))
+    keys.discard("self")
+    keys.discard("device")
+    return frozenset(keys)
+
+
+def _declared(cls, method: str, kwargs: Dict[str, Any], rel: str,
+              anchor: str, findings: List[Finding],
+              sentinel=None):
+    """Call a static declaration classmethod, turning an exception
+    (the stage statically rejects these kwargs) into a finding under
+    the rule family the declaration belongs to."""
+    try:
+        return getattr(cls, method)(**kwargs)
+    except Exception as e:
+        rule = "RNB-G008" if "dtype" in method else "RNB-G003"
+        findings.append(Finding(
+            rule, rel, 0, anchor,
+            "%s.%s rejects the configured kwargs: %s"
+            % (cls.__name__, method, e)))
+        return sentinel
+
+
+def _emission_rows(shapes, row_buckets, rel: str, anchor: str,
+                   findings: List[Finding]) -> Optional[set]:
+    """The set of row counts (axis 0 of tensor 0) a group can emit."""
+    max_rows = int(shapes[0][0])
+    if not row_buckets:
+        return {max_rows}
+    try:
+        return set(normalize_row_buckets(row_buckets, max_rows,
+                                         "declared max rows"))
+    except Exception as e:
+        findings.append(Finding("RNB-G006", rel, 0, anchor,
+                                "invalid row_buckets: %s" % e))
+        return None
+
+
+def check_config(path: str, root: str = ".") -> List[Finding]:
+    """All graph findings for one config file."""
+    rel = _rel(path, root)
+    try:
+        config = load_config(path)
+    except ConfigError as e:
+        return [Finding("RNB-G001", rel, 0, "parse", str(e))]
+    findings: List[Finding] = []
+    _resolve(config.video_path_iterator, rel, "video_path_iterator",
+             findings)
+
+    classes = []
+    for step_idx, step in enumerate(config.steps):
+        classes.append(_resolve(step.model, rel, "step%d" % step_idx,
+                                findings))
+
+    # per-group local checks: selector arity, unconsumed keys, cache_mb
+    for step_idx, (step, cls) in enumerate(zip(config.steps, classes)):
+        for group_idx, group in enumerate(step.groups):
+            anchor = "step%d.group%d" % (step_idx, group_idx)
+            kwargs = step.kwargs_for_group(group_idx)
+
+            if group.out_queues:
+                sel_cls = _resolve(group.queue_selector, rel, anchor,
+                                   findings)
+                if sel_cls is not None:
+                    try:
+                        sel_cls(len(group.out_queues))
+                    except Exception as e:
+                        findings.append(Finding(
+                            "RNB-G004", rel, 0, anchor,
+                            "queue selector %s rejects %d out-queue(s): "
+                            "%s" % (group.queue_selector,
+                                    len(group.out_queues), e)))
+
+            if "cache_mb" in kwargs:
+                cache_mb = kwargs["cache_mb"]
+                if (not isinstance(cache_mb, (int, float))
+                        or isinstance(cache_mb, bool) or cache_mb < 0):
+                    findings.append(Finding(
+                        "RNB-G007", rel, 0, anchor,
+                        "'cache_mb' must be a non-negative number "
+                        "(0 disables caching), got %r" % (cache_mb,)))
+
+            if cls is not None:
+                unknown = sorted(
+                    k for k in kwargs
+                    if k not in consumed_config_keys(cls)
+                    and not k.startswith("_"))
+                for key in unknown:
+                    findings.append(Finding(
+                        "RNB-G005", rel, 0, "%s.%s" % (anchor, key),
+                        "config key %r is not a constructor parameter "
+                        "of %s — the open kwargs passthrough would "
+                        "silently drop it" % (key, cls.__name__)))
+
+    # step-to-step metadata propagation along the queue wiring
+    for step_idx in range(1, config.num_steps):
+        p_step, c_step = config.steps[step_idx - 1], config.steps[step_idx]
+        p_cls, c_cls = classes[step_idx - 1], classes[step_idx]
+        if p_cls is None or c_cls is None:
+            continue
+        for cg_idx, cgroup in enumerate(c_step.groups):
+            ckwargs = c_step.kwargs_for_group(cg_idx)
+            c_anchor = "step%d.group%d" % (step_idx, cg_idx)
+            cin = _declared(c_cls, "input_shape_for", ckwargs, rel,
+                            c_anchor, findings)
+            cdtype = _declared(c_cls, "input_dtype_for", ckwargs, rel,
+                               c_anchor, findings)
+            for pg_idx, pgroup in enumerate(p_step.groups):
+                if cgroup.in_queue not in pgroup.out_queues:
+                    continue
+                pkwargs = p_step.kwargs_for_group(pg_idx)
+                edge = "step%d.group%d->step%d.group%d" % (
+                    step_idx - 1, pg_idx, step_idx, cg_idx)
+                pout = _declared(p_cls, "output_shape_for", pkwargs, rel,
+                                 edge, findings)
+                pdtype = _declared(p_cls, "output_dtype_for", pkwargs,
+                                   rel, edge, findings)
+                _check_edge(rel, edge, p_cls, c_cls, pkwargs, ckwargs,
+                            p_step.num_segments, pout, pdtype,
+                            cin, cdtype, findings)
+    return findings
+
+
+def _check_edge(rel: str, edge: str, p_cls, c_cls,
+                pkwargs: Dict[str, Any], ckwargs: Dict[str, Any],
+                num_segments: int,
+                pout, pdtype, cin, cdtype,
+                findings: List[Finding]) -> None:
+    """Shape/dtype/bucket compatibility of one wired producer-group ->
+    consumer-group edge."""
+    if cin is None:
+        return  # consumer declares no tensor expectations
+    if pout is None:
+        findings.append(Finding(
+            "RNB-G003", rel, 0, edge,
+            "%s declares no tensor outputs but %s expects input "
+            "shapes %r" % (p_cls.__name__, c_cls.__name__, cin)))
+        return
+    pout = tuple(map(tuple, pout))
+    cin = tuple(map(tuple, cin))
+    try:
+        seg_out = get_segmented_shapes(pout, num_segments)
+    except ValueError as e:
+        findings.append(Finding("RNB-G003", rel, 0, edge, str(e)))
+        return
+    if len(seg_out) != len(cin):
+        findings.append(Finding(
+            "RNB-G003", rel, 0, edge,
+            "%s emits %d tensor(s) %r but %s expects %d %r"
+            % (p_cls.__name__, len(seg_out), seg_out, c_cls.__name__,
+               len(cin), cin)))
+        return
+    for idx, (got, want) in enumerate(zip(seg_out, cin)):
+        if (len(got) != len(want) or tuple(got[1:]) != tuple(want[1:])
+                or got[0] > want[0]):
+            findings.append(Finding(
+                "RNB-G003", rel, 0, edge,
+                "output %d declares %r but the consumer expects %r "
+                "(row axis may be smaller, never larger; trailing "
+                "dims must match exactly)" % (idx, got, want)))
+    if pdtype is not None and cdtype is not None and pdtype != cdtype:
+        findings.append(Finding(
+            "RNB-G008", rel, 0, edge,
+            "%s emits dtype %s but %s expects %s"
+            % (p_cls.__name__, pdtype, c_cls.__name__, cdtype)))
+
+    # row-bucket coverage: every row count the producer can emit must
+    # be a shape the consumer warmed/compiled, or the first occurrence
+    # is a silent recompile inside the measured window
+    if getattr(c_cls, "REPACKS_ROWS", False):
+        return
+    emission = _emission_rows(seg_out, pkwargs.get("row_buckets")
+                              if num_segments <= 1 else None,
+                              rel, edge, findings)
+    if emission is None:
+        return
+    # the consumer's warmed set: its configured row_buckets when the
+    # class consumes them, else the single declared input max
+    c_max = int(cin[0][0])
+    warmed = {c_max}
+    if ("row_buckets" in consumed_config_keys(c_cls)
+            and ckwargs.get("row_buckets")):
+        try:
+            warmed = set(normalize_row_buckets(
+                ckwargs["row_buckets"], c_max, "declared input max"))
+        except Exception as e:
+            findings.append(Finding("RNB-G006", rel, 0, edge,
+                                    "invalid consumer row_buckets: %s"
+                                    % e))
+            return
+    uncovered = sorted(emission - warmed)
+    if uncovered:
+        findings.append(Finding(
+            "RNB-G006", rel, 0, edge,
+            "producer can emit row counts %s the consumer never "
+            "warmed (warmed: %s) — each is a silent recompile in the "
+            "measured window; align 'row_buckets'/'max_rows' across "
+            "the edge" % (uncovered, sorted(warmed))))
+
+
+def check_configs(paths: List[str], root: str = ".") -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        findings.extend(check_config(path, root))
+    return findings

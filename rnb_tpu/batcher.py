@@ -18,23 +18,9 @@ import numpy as np
 
 from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
 from rnb_tpu.telemetry import TimeCardList
+from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 
 MAX_ROWS = 15  # max clips per fused batch, matches the loader's max
-
-_jax_mods = None
-
-
-def _jax_numpy():
-    """Lazily imported, module-cached (jax, jnp) pair: the fused-emit
-    hot path must not pay per-emission interpreter import machinery
-    (sys.modules lookup + module-dict binding) — same idiom as the
-    loader's shared-cache modules."""
-    global _jax_mods
-    if _jax_mods is None:
-        import jax
-        import jax.numpy as jnp
-        _jax_mods = (jax, jnp)
-    return _jax_mods
 
 
 class Batcher(StageModel):
@@ -49,6 +35,10 @@ class Batcher(StageModel):
     ``num_videos mod batch`` requests still complete (the reference's
     batcher simply stranded them, reference batcher.py:17-34).
     """
+
+    # any upstream bucket set is acceptable: the batcher concatenates
+    # valid rows and re-pads to its OWN bucket set / max shape
+    REPACKS_ROWS = True
 
     def __init__(self, device, batch=1, shapes=None, max_rows=MAX_ROWS,
                  consecutive_frames=8, frame_hw=112, row_buckets=None,
@@ -94,6 +84,13 @@ class Batcher(StageModel):
             return tuple(tuple(int(d) for d in s) for s in shapes)
         return ((int(max_rows), int(consecutive_frames),
                  frame_hw, frame_hw, 3),)
+
+    @classmethod
+    def input_shape_for(cls, **model_kwargs):
+        # static counterpart of input_shape(): the batcher re-packs
+        # whatever it receives, so its input max shapes ARE its
+        # declared output shapes (same constructor-args derivation)
+        return cls.output_shape_for(**model_kwargs)
 
     def __call__(self, tensors, non_tensors, time_card):
         if self.batch <= 1:

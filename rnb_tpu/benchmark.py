@@ -93,6 +93,10 @@ class BenchmarkResult:
     cache_inserts: int = 0
     cache_evictions: int = 0
     cache_coalesced: int = 0
+    #: entries skipped because a single batch exceeded the whole
+    #: cache_mb budget (was written to log-meta but missing here until
+    #: the schema checker's BenchmarkResult cross-check caught it)
+    cache_oversize: int = 0
     cache_bytes_resident: int = 0
 
 
@@ -463,6 +467,7 @@ def run_benchmark(config_path: str,
         cache_inserts=cache_stats["inserts"] if cache_stats else 0,
         cache_evictions=cache_stats["evictions"] if cache_stats else 0,
         cache_coalesced=cache_stats["coalesced"] if cache_stats else 0,
+        cache_oversize=cache_stats["oversize"] if cache_stats else 0,
         cache_bytes_resident=(cache_stats["bytes_resident"]
                               if cache_stats else 0),
     )

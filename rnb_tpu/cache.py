@@ -54,6 +54,10 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
+from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
+
 #: stat signature for ids that are not files on disk (synth:// ids):
 #: their content is deterministic per id, so a constant signature is
 #: content-correct
@@ -169,14 +173,13 @@ class ClipCache:
         video is seen (amortized away by every later hit; the
         ``loader.cache_insert`` hostprof section accounts for it).
         """
-        import numpy as np
         if int(np.prod(target_shape)) > self.capacity_bytes:
             with self._lock:
                 self.num_oversize += 1
             return False
         if self.contains(key):
             return False
-        import jax
+        jax, _ = _jax_numpy()
         padded = np.zeros(target_shape, dtype=np.uint8)
         padded[:valid] = clips[:valid]
         device_batch = jax.device_put(padded, self.device)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -29,16 +30,20 @@ import numpy as np
 from rnb_tpu import hostprof
 from rnb_tpu.cache import content_key
 from rnb_tpu.decode import get_decoder
-from rnb_tpu.faults import FATAL, classify_error, fault_reason
+from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_RGB,
+                                   PIX_YUV420)
+from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
 from rnb_tpu.models.r2p1d import checkpoint as ckpt
 from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
                                           LAYER_INPUT_SHAPES, NUM_LAYERS,
                                           R2Plus1DClassifier,
                                           R18_LAYER_SIZES)
 from rnb_tpu.models.r2p1d.sampler import R2P1DSampler
+from rnb_tpu.ops.yuv import packed_frame_bytes
 from rnb_tpu.selector import QueueSelector
 from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
-from rnb_tpu.telemetry import TimeCard
+from rnb_tpu.telemetry import TimeCard, TimeCardList
+from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 from rnb_tpu import video_path_provider
 from rnb_tpu.video_path_provider import VideoPathIterator
 
@@ -369,7 +374,6 @@ class R2P1DLoader(StageModel):
     def _batch_shape(self, rows: Optional[int] = None):
         n = rows if rows is not None else self.max_clips
         if self.pixel_path == "yuv420":
-            from rnb_tpu.ops.yuv import packed_frame_bytes
             return (n, self.consecutive_frames,
                     packed_frame_bytes(FRAME_HW, FRAME_HW))
         return (n, self.consecutive_frames, FRAME_HW, FRAME_HW, 3)
@@ -392,11 +396,20 @@ class R2P1DLoader(StageModel):
                          consecutive_frames: int = CONSECUTIVE_FRAMES,
                          pixel_path: str = "rgb", **_kwargs):
         if pixel_path == "yuv420":
-            from rnb_tpu.ops.yuv import packed_frame_bytes
             return ((int(max_clips), int(consecutive_frames),
                      packed_frame_bytes(FRAME_HW, FRAME_HW)),)
         return ((int(max_clips), int(consecutive_frames),
                  FRAME_HW, FRAME_HW, 3),)
+
+    @classmethod
+    def output_dtype_for(cls, raw_output: bool = False,
+                         pixel_path: str = "rgb", **_kwargs):
+        # raw mode ships the padded uint8 batch; yuv420 ships packed u8
+        # planes for the consumer's fused ingest; otherwise the jitted
+        # preprocess emits normalized bfloat16
+        if raw_output or pixel_path == "yuv420":
+            return "uint8"
+        return "bfloat16"
 
     #: clips per native-pool ticket when a submitted video fans out:
     #: small enough that a 15-clip video engages several workers, large
@@ -495,8 +508,6 @@ class R2P1DLoader(StageModel):
         # vanished resolves to SyntheticDecoder there, and submitting it
         # to the native pool anyway would kill the run the synchronous
         # path survives
-        from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder,
-                                           PIX_RGB, PIX_YUV420)
         if isinstance(decoder, NativeY4MDecoder):
             out = np.empty(self._batch_shape(n), dtype=np.uint8)
             pixfmt = (PIX_YUV420 if self.pixel_path == "yuv420"
@@ -524,7 +535,6 @@ class R2P1DLoader(StageModel):
                 raise
             return _DecodeHandle(out, n, pool=pool, tickets=tickets)
         if self._fallback_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
             self._fallback_pool = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="rnb-decode")
 
@@ -547,7 +557,7 @@ class R2P1DLoader(StageModel):
         only: this line is reached only once decode and transfer both
         completed, so failed/contained requests never populate entries.
         """
-        import jax
+        jax, _ = _jax_numpy()
         target = self._batch_shape(self._bucket_for(n))
         if clips.shape == target:
             # bucket == clip count (the dominant 1-clip case): the
@@ -721,7 +731,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         take_failed() queue instead of poisoning its batchmates or
         being mis-attributed to whichever request triggered the
         emission; unclassified errors stay fatal."""
-        from rnb_tpu.faults import TRANSIENT
         handle, video = rec.handle, rec.video
         try:
             handle.wait(video)
@@ -779,7 +788,7 @@ class R2P1DFusingLoader(R2P1DLoader):
         into one padded batch + TimeCardList — or None when every
         taken request's decode failed (the failures are on the
         take_failed() queue)."""
-        import jax
+        jax, _ = _jax_numpy()
 
         cap = self.max_clips
         take, rows = [], 0
@@ -838,7 +847,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         if self._preprocess is not None:
             with hostprof.section("loader.preprocess_dispatch"):
                 batch = self._preprocess(batch)
-        from rnb_tpu.telemetry import TimeCardList
         return ((PaddedBatch(batch, row),), None, TimeCardList(cards))
 
     def _emit_hit(self, entry, time_card):
@@ -846,7 +854,6 @@ class R2P1DFusingLoader(R2P1DLoader):
         no decode to overlap and no host work to amortize, so holding
         it for fusion would only add latency. Wrapped in a TimeCardList
         for schema uniformity with fused emissions."""
-        from rnb_tpu.telemetry import TimeCardList
         tensors, non_tensors, tc = self._materialize_hit(entry, time_card)
         return tensors, non_tensors, TimeCardList([tc])
 
@@ -1016,35 +1023,20 @@ class R2P1DRunner(StageModel):
                                          num_classes, layer_sizes,
                                          ckpt_path, self._jax_device,
                                          bool(factored_shortcut))
-        # warm-up on the exact steady-state shape. The temporal extent
-        # follows the pipeline's consecutive_frames everywhere: at layer
-        # 1 it IS consecutive_frames; mid-pipeline it is whatever the
-        # upstream range [1..start-1] downsampled those frames to (the
-        # static LAYER_INPUT_SHAPES table only covers the default 8)
-        from rnb_tpu.models.r2p1d.network import range_output_shape
-        if self.pixel_path == "yuv420":
-            from rnb_tpu.ops.yuv import packed_frame_bytes
-            shape = (int(consecutive_frames),
-                     packed_frame_bytes(FRAME_HW, FRAME_HW))
-        elif self.start_index == 1:
-            shape = (int(consecutive_frames),) + LAYER_INPUT_SHAPES[1][1:]
-        else:
-            shape = range_output_shape(1, self.start_index - 1,
-                                       int(consecutive_frames))
-        self._steady_shape = (self.max_rows,) + tuple(shape)
-        # warm up with the dtype the pipeline actually flows: the
-        # loader's preprocess emits bfloat16 into layer 1 (packed uint8
-        # planes under pixel_path='yuv420'), while an upstream network
-        # stage emits float32 activations
-        # (R2Plus1DClassifier casts its output) — a wrong-dtype dummy
+        # warm-up on the exact steady-state shape and dtype — both come
+        # from the same static declarations (input_shape_for /
+        # input_dtype_for) the pipeline checker matches against the
+        # upstream step, so the compiled signature and the declared
+        # wire contract can never diverge. A wrong-shape/dtype dummy
         # would compile a signature the hot loop never uses and pay the
-        # real compile on the first request instead
+        # real compile on the first request instead.
+        self._steady_shape = self.input_shape_for(
+            start_index=self.start_index, max_rows=self.max_rows,
+            consecutive_frames=consecutive_frames,
+            pixel_path=self.pixel_path)[0]
         import jax.numpy as jnp
-        if self.pixel_path == "yuv420":
-            warm_dtype = jnp.uint8
-        else:
-            warm_dtype = (jnp.bfloat16 if self.start_index == 1
-                          else jnp.float32)
+        warm_dtype = getattr(jnp, self.input_dtype_for(
+            start_index=self.start_index, pixel_path=self.pixel_path))
         # match the loader's row bucketing: compile one executable per
         # bucket row count so no compile lands in the measured window
         warm_rows = _normalize_row_buckets(row_buckets, self.max_rows,
@@ -1058,6 +1050,44 @@ class R2P1DRunner(StageModel):
 
     def input_shape(self):
         return (self._steady_shape,)
+
+    @classmethod
+    def input_shape_for(cls, start_index: int = 1,
+                        max_rows: int = MAX_CLIPS,
+                        consecutive_frames: int = CONSECUTIVE_FRAMES,
+                        pixel_path: str = "rgb", **_kwargs):
+        # the exact steady-state input shape warm-up compiles. The
+        # temporal extent follows the pipeline's consecutive_frames
+        # everywhere: at layer 1 it IS consecutive_frames; mid-pipeline
+        # it is whatever the upstream range [1..start-1] downsampled
+        # those frames to (the static LAYER_INPUT_SHAPES table only
+        # covers the default 8)
+        from rnb_tpu.models.r2p1d.network import range_output_shape
+        if pixel_path == "yuv420":
+            shape = (int(consecutive_frames),
+                     packed_frame_bytes(FRAME_HW, FRAME_HW))
+        elif int(start_index) == 1:
+            shape = ((int(consecutive_frames),)
+                     + tuple(LAYER_INPUT_SHAPES[1][1:]))
+        else:
+            shape = range_output_shape(1, int(start_index) - 1,
+                                       int(consecutive_frames))
+        return ((int(max_rows),) + tuple(shape),)
+
+    @classmethod
+    def input_dtype_for(cls, start_index: int = 1,
+                        pixel_path: str = "rgb", **_kwargs):
+        # the dtype the pipeline actually flows: packed uint8 planes
+        # under pixel_path='yuv420'; the loader's preprocess emits
+        # bfloat16 into layer 1; an upstream network stage emits
+        # float32 activations (R2Plus1DClassifier casts its output)
+        if pixel_path == "yuv420":
+            return "uint8"
+        return "bfloat16" if int(start_index) == 1 else "float32"
+
+    @classmethod
+    def output_dtype_for(cls, **_kwargs):
+        return "float32"
 
     @staticmethod
     def output_shape():
@@ -1084,7 +1114,7 @@ class R2P1DRunner(StageModel):
         return ((int(max_rows),) + per_row,)
 
     def __call__(self, tensors, non_tensors, time_card):
-        import jax
+        jax, _ = _jax_numpy()
         pb = tensors[0]
         x = jax.device_put(pb.data, self._jax_device)
         out = self._apply(self._variables, x)
@@ -1096,6 +1126,12 @@ class R2P1DSingleStep(StageModel):
     baseline (reference models/r2p1d/model.py:161-235). Emits the
     predicted class id as the non-tensor payload; declares no tensor
     outputs, so the runtime allocates no rings for it."""
+
+    # open config kwargs (row_buckets, pixel_path, cache_mb, ...) are
+    # forwarded to the embedded loader/runner pair — the static
+    # unconsumed-key check (rnb_tpu.analysis.graph) honors their
+    # constructor signatures through this declaration
+    FORWARDS_CONFIG_TO = (R2P1DLoader, R2P1DRunner)
 
     def __init__(self, device, num_classes: int = KINETICS_CLASSES,
                  layer_sizes=R18_LAYER_SIZES, max_clips: int = MAX_CLIPS,
@@ -1133,7 +1169,7 @@ class R2P1DSingleStep(StageModel):
         return None
 
     def __call__(self, tensors, non_tensors, time_card):
-        import jax.numpy as jnp
+        _, jnp = _jax_numpy()
         (pb,), _, time_card = self.loader(None, non_tensors, time_card)
         (logits,), _, time_card = self.net((pb,), None, time_card)
         # sum+argmax on device; only the class id crosses to the host
@@ -1232,6 +1268,24 @@ class R2P1DMeshRunner(StageModel):
         # path: the sharded step's own batch geometry
         return (self._si.batch_shape(1)[1:],)
 
+    @classmethod
+    def input_shape_for(cls, max_clips: int = MAX_CLIPS,
+                        consecutive_frames: int = CONSECUTIVE_FRAMES,
+                        pixel_path: str = "rgb", **_kwargs):
+        # mirrors ShardedInference.batch_shape(1)[1:] without building
+        # the mesh: one raw loader video batch per dispatch row
+        if pixel_path == "yuv420":
+            return ((int(max_clips), int(consecutive_frames),
+                     packed_frame_bytes(FRAME_HW, FRAME_HW)),)
+        return ((int(max_clips), int(consecutive_frames),
+                 FRAME_HW, FRAME_HW, 3),)
+
+    @classmethod
+    def input_dtype_for(cls, **_kwargs):
+        # consumes the loader's raw_output uint8 batches in either
+        # pixel path (the sharded program owns normalize/ingest)
+        return "uint8"
+
     @staticmethod
     def output_shape():
         return None
@@ -1239,10 +1293,7 @@ class R2P1DMeshRunner(StageModel):
     def _dispatch(self, pbs, cards):
         """One sharded step over len(pbs)==dp videos; async device
         preds out, bounded in-flight window."""
-        import jax
-        import jax.numpy as jnp
-
-        from rnb_tpu.telemetry import TimeCardList
+        jax, jnp = _jax_numpy()
 
         # re-home the loader's device batches straight onto the mesh
         # sharding (device-to-device, ICI on hardware — no host bounce)
@@ -1289,9 +1340,7 @@ class R2P1DMeshRunner(StageModel):
         axis with zero videos (mask 0 — dead rows, no result rows)."""
         if not self._acc:
             return None
-        import jax.numpy as jnp
-
-        from rnb_tpu.stage import PaddedBatch
+        _, jnp = _jax_numpy()
         pbs, cards = zip(*self._acc)
         self._acc = []
         pbs = list(pbs)
@@ -1302,7 +1351,7 @@ class R2P1DMeshRunner(StageModel):
     def finalize(self):
         """Drain outstanding device work (called by the executor before
         the finish barrier, keeping the measured window honest)."""
-        import jax
+        jax, _ = _jax_numpy()
         while self._inflight:
             jax.block_until_ready(self._inflight.popleft())
 

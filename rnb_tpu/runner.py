@@ -44,10 +44,12 @@ publishes as soon as XLA has the work queued; dataflow stays correct
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -61,6 +63,7 @@ from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
 from rnb_tpu.stage import PaddedBatch
 from rnb_tpu.telemetry import TimeCardList, TimeCardSummary, logname
 from rnb_tpu.utils.class_utils import load_class
+from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 
 NUM_SUMMARY_SKIPS = 10  # steady-state summaries skip warm records
 QUEUE_POLL_S = 0.05
@@ -144,8 +147,7 @@ def split_segments(payload, num_segments: int):
     (11 rows, 3 segments -> 4, 4, 3). Segments may be empty when the
     batch has fewer valid rows than segments.
     """
-    import jax.numpy as jnp
-    import math
+    _, jnp = _jax_numpy()
 
     if num_segments <= 1:
         return [payload]
@@ -170,7 +172,9 @@ def split_segments(payload, num_segments: int):
 
 
 def _block_on(payload) -> None:
-    import jax
+    # deliberate host sync: the executor's stream.synchronize() analog
+    # (sync_outputs honesty) — baselined under RNB-H006
+    jax, _ = _jax_numpy()
     jax.block_until_ready([pb.data for pb in payload])
 
 
@@ -344,7 +348,6 @@ def runner(ctx: RunnerContext) -> None:
     if (model is not None and ctx.input_rings is None
             and hasattr(model, "submit") and hasattr(model, "complete")):
         prefetch_depth = int(getattr(model, "prefetch_depth", 0) or 0)
-    from collections import deque
     pending = deque()  # (handle, non_tensors, time_card) submitted
     saw_marker = False
 

@@ -86,6 +86,15 @@ def normalize_row_buckets(row_buckets, max_rows: int, what: str
 class StageModel:
     """Abstract contract every pipeline stage implements.
 
+    Besides the instance lifecycle below, stages expose a *static*
+    face — ``output_shape_for`` / ``input_shape_for`` and the dtype
+    variants — classmethods that derive wire metadata from the step's
+    JSON kwargs without constructing the stage (no device, no
+    checkpoint, no warm-up). The runtime sizes buffer rings from the
+    output side; the static pipeline checker (rnb_tpu.analysis.graph)
+    walks both sides step-to-step to reject shape/dtype-incompatible
+    wiring before any device is touched.
+
     Lifecycle (all in the executor thread that owns the stage's devices):
 
     * ``__init__(device, **kwargs)`` — build the stage, load weights, and
@@ -116,6 +125,20 @@ class StageModel:
       runner_model.py:48-81, runner.py:130-134).
     """
 
+    #: True for stages that re-pack incoming rows into their own
+    #: batches (Batcher): any upstream row-bucket set is acceptable on
+    #: their input, so bucket-compatibility checks skip them. Stages
+    #: that jit-compile per incoming bucket shape (network runners)
+    #: leave this False — their warmed bucket set must cover every
+    #: bucket the producer can emit.
+    REPACKS_ROWS = False
+
+    #: Classes this stage forwards its open config kwargs to (composed
+    #: stages, e.g. R2P1DSingleStep embedding a loader + runner). The
+    #: static unconsumed-config-key check unions their named
+    #: constructor parameters with this class's own.
+    FORWARDS_CONFIG_TO: Tuple[type, ...] = ()
+
     def __init__(self, device, **kwargs):
         self.device = device
 
@@ -124,6 +147,32 @@ class StageModel:
 
     @staticmethod
     def output_shape() -> Optional[Tuple[Tuple[int, ...], ...]]:
+        return None
+
+    @classmethod
+    def input_shape_for(cls, **model_kwargs) -> Optional[
+            Tuple[Tuple[int, ...], ...]]:
+        """Config-aware *expected input* max shapes, or None when the
+        stage takes no tensor inputs (first-stage loaders) or accepts
+        anything. The static counterpart of ``input_shape()`` —
+        derivable from the step's JSON kwargs alone, so the pipeline
+        checker can match it against the upstream step's declared
+        output shapes without constructing the stage."""
+        del model_kwargs
+        return None
+
+    @classmethod
+    def input_dtype_for(cls, **model_kwargs) -> Optional[str]:
+        """Expected input dtype name ("uint8", "bfloat16", "float32"),
+        or None when any dtype is acceptable / unknown."""
+        del model_kwargs
+        return None
+
+    @classmethod
+    def output_dtype_for(cls, **model_kwargs) -> Optional[str]:
+        """Produced output dtype name, or None when unknown (e.g. a
+        pass-through stage that emits whatever it receives)."""
+        del model_kwargs
         return None
 
     @classmethod

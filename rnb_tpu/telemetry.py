@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 import time
-from collections import OrderedDict
+from collections import OrderedDict, namedtuple
 from typing import IO, Iterable, List, Optional, Sequence
 
 
@@ -65,6 +65,66 @@ def latency_percentiles(latencies_ms: Sequence[float],
 #: outcome (rnb_tpu.cache: True=hit, False=miss; cache_coalesced marks
 #: a request that shared another request's in-flight decode)
 CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced")
+
+
+# -- the declared telemetry schema ------------------------------------
+#
+# PRs 1-2 each extended the TimeCard/report schema by hand in three
+# places (stamp sites, scripts/parse_utils.py, README) — exactly the
+# silent drift a stamp registry exists to stop. Every timing-stamp
+# pattern, log-meta line and report trailer the tree may write is
+# DECLARED here; the static schema checker
+# (rnb_tpu.analysis.schema, gated in tier-1) cross-checks these
+# declarations against the actual stamp/write sites AND against what
+# scripts/parse_utils.py parses, so a stamp can neither appear
+# unregistered nor silently vanish from reports.
+# ``python scripts/parse_utils.py --stamps`` prints the generated
+# reference.
+
+#: one declared telemetry element: ``pattern`` uses ``{step}`` for the
+#: pipeline-step index (stamp sites format it with ``%d``); merged
+#: segment cards additionally suffix post-fork stamps with
+#: ``-{sub_id}`` (TimeCard.merge)
+StampSpec = namedtuple("StampSpec", ("pattern", "producer", "description"))
+
+#: every TimeCard timing-stamp pattern any code path may record
+STAMP_REGISTRY = (
+    StampSpec("enqueue_filename", "rnb_tpu/client.py",
+              "client created the request and enqueued its video path"),
+    StampSpec("runner{step}_start", "rnb_tpu/runner.py",
+              "stage executor popped the request off its input queue"),
+    StampSpec("inference{step}_start", "rnb_tpu/runner.py",
+              "model call (or prefetched-decode completion) began"),
+    StampSpec("inference{step}_finish", "rnb_tpu/runner.py",
+              "stage output ready (device-synced unless async_dispatch)"),
+)
+
+#: every ``<Prefix>:``-keyed line rnb_tpu/benchmark.py may write into
+#: ``logs/<job>/log-meta.txt`` (plus one bare ``<start> <end>``
+#: timestamp line carrying no prefix)
+META_LINE_REGISTRY = (
+    StampSpec("Args:", "rnb_tpu/benchmark.py",
+              "argparse-style repr of the launch arguments"),
+    StampSpec("Termination flag:", "rnb_tpu/benchmark.py",
+              "job termination reason code (TerminationFlag)"),
+    StampSpec("Faults:", "rnb_tpu/benchmark.py",
+              "job-wide num_failed/num_shed/num_retries counters"),
+    StampSpec("Failure reasons:", "rnb_tpu/benchmark.py",
+              "JSON per-reason contained-failure counts"),
+    StampSpec("Shed sites:", "rnb_tpu/benchmark.py",
+              "JSON per-site shed counts"),
+    StampSpec("Cache:", "rnb_tpu/benchmark.py",
+              "clip-cache counters (cache-enabled runs only)"),
+)
+
+#: every ``# <kind> ...`` trailer a per-instance timing table may carry
+#: (TimeCardSummary.save_full_report)
+TABLE_TRAILER_REGISTRY = (
+    StampSpec("faults", "rnb_tpu/telemetry.py",
+              "per-instance failed/shed/retry counts + reasons"),
+    StampSpec("cache", "rnb_tpu/telemetry.py",
+              "per-instance completed-request cache attribution"),
+)
 
 
 class TimeCard:

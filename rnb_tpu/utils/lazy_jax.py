@@ -1,0 +1,27 @@
+"""Module-cached lazy (jax, jax.numpy) import.
+
+Hot paths must not pay per-call interpreter import machinery
+(sys.modules lookup + module-dict binding, ~1 us each) — the PR 2
+Batcher hoist, generalized into the one helper every per-request code
+path shares. Modules that cannot import jax at module top (import cost
+for jax-free tooling, or circularity) call :func:`jax_numpy` once per
+call site; the tuple is bound after the first call.
+
+The static hot-path lint (rnb_tpu.analysis.hotpath, rule RNB-H002)
+flags ``import`` statements inside per-request code; this helper is
+the prescribed fix.
+"""
+
+from __future__ import annotations
+
+_jax_mods = None
+
+
+def jax_numpy():
+    """-> the (jax, jax.numpy) module pair, imported once per process."""
+    global _jax_mods
+    if _jax_mods is None:
+        import jax
+        import jax.numpy as jnp
+        _jax_mods = (jax, jnp)
+    return _jax_mods

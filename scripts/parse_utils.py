@@ -378,16 +378,60 @@ def check_job(job_dir: str) -> List[str]:
     return problems
 
 
+def print_stamp_registry(out=None) -> None:
+    """Emit the generated telemetry-schema reference (``--stamps``):
+    the declared stamp patterns, log-meta lines and table trailers
+    from rnb_tpu.telemetry — the registries the static schema checker
+    (rnb_tpu.analysis.schema) holds this parser to."""
+    import sys as _sys
+    out = out or _sys.stdout
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from rnb_tpu.telemetry import (META_LINE_REGISTRY, STAMP_REGISTRY,
+                                   TABLE_TRAILER_REGISTRY, CONTENT_STAMPS)
+    out.write("# Telemetry schema reference (generated by "
+              "parse_utils.py --stamps)\n")
+    out.write("# Source of truth: rnb_tpu/telemetry.py registries; "
+              "cross-checked in tier-1 by scripts/rnb_lint.py.\n\n")
+    out.write("## TimeCard stamps ({step} = pipeline step index; "
+              "merged segment\n## cards suffix post-fork stamps with "
+              "-{sub_id})\n")
+    for spec in STAMP_REGISTRY:
+        out.write("%-26s %-22s %s\n" % (spec.pattern, spec.producer,
+                                        spec.description))
+    out.write("\n## Content stamps (TimeCard attributes that survive "
+              "fork/merge)\n")
+    out.write("%s\n" % " ".join(CONTENT_STAMPS))
+    out.write("\n## log-meta.txt lines (plus one bare '<start> <end>' "
+              "timestamp line)\n")
+    for spec in META_LINE_REGISTRY:
+        out.write("%-26s %-22s %s\n" % (spec.pattern, spec.producer,
+                                        spec.description))
+    out.write("\n## Timing-table trailers ('# <kind> ...')\n")
+    for spec in TABLE_TRAILER_REGISTRY:
+        out.write("%-26s %-22s %s\n" % (spec.pattern, spec.producer,
+                                        spec.description))
+
+
 def main(argv=None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
         description="Benchmark log parsing and consistency checking")
-    parser.add_argument("job_dirs", nargs="+",
+    parser.add_argument("job_dirs", nargs="*",
                         help="logs/<job_id> directories to inspect")
     parser.add_argument("--check", action="store_true",
                         help="cross-check log-meta vs timing tables vs "
                              "trailers; non-zero exit on inconsistency")
+    parser.add_argument("--stamps", action="store_true",
+                        help="print the generated telemetry-schema "
+                             "reference (stamp registry) and exit")
     args = parser.parse_args(argv)
+    if args.stamps:
+        print_stamp_registry()
+        return 0
+    if not args.job_dirs:
+        parser.error("job_dirs required unless --stamps is given")
     status = 0
     for job_dir in args.job_dirs:
         if args.check:

@@ -1,0 +1,20 @@
+"""RNB-H001: host-sync calls inside jitted functions — both the
+module-level shape and the factory-nested shape every real jit site
+in the tree uses (`fn = jax.jit(apply)` inside a builder)."""
+
+import jax
+import numpy as np
+
+
+def apply_fn(variables, x):
+    return np.asarray(x) + 1
+
+
+apply = jax.jit(apply_fn)
+
+
+def make_apply(model):
+    def apply_nested(variables, x):
+        return float(x) + 1
+
+    return jax.jit(apply_nested)

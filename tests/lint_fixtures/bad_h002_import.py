@@ -1,0 +1,7 @@
+"""RNB-H002: import inside a per-request hot path."""
+
+
+class Stage:
+    def __call__(self, tensors, non_tensors, time_card):
+        import json
+        return json.dumps({}), non_tensors, time_card

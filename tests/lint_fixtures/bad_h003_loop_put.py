@@ -1,0 +1,9 @@
+"""RNB-H003: device_put inside a per-request loop."""
+
+
+class Stage:
+    def __call__(self, tensors, non_tensors, time_card):
+        out = []
+        for pb in tensors:
+            out.append(jax.device_put(pb, self.device))
+        return tuple(out), non_tensors, time_card

@@ -1,0 +1,8 @@
+"""RNB-H004: unseeded RNG in fault-injection code."""
+
+import random
+
+
+class MyFaultPlan:
+    def draw(self, step_idx, request_id):
+        return random.random()

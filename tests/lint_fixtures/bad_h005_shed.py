@@ -1,0 +1,7 @@
+"""RNB-H005: ring-slot write precedes the shed decision."""
+
+
+def publish(ctx, payload, time_card, summary, full):
+    ctx.output_ring.slots[0].write(payload)
+    if full:
+        _shed_item(ctx, time_card, summary)

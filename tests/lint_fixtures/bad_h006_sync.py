@@ -1,0 +1,7 @@
+"""RNB-H006: host sync on a per-request hot path."""
+
+
+class Stage:
+    def __call__(self, tensors, non_tensors, time_card):
+        tensors[0].data.block_until_ready()
+        return tensors, non_tensors, time_card

@@ -1,0 +1,10 @@
+"""RNB-T001: records an unregistered stamp (plus all registered ones,
+so no dead-registry finding muddies the fixture)."""
+
+
+def stamp_all(tc, step):
+    tc.record("enqueue_filename")
+    tc.record("runner%d_start" % step)
+    tc.record("inference%d_start" % step)
+    tc.record("inference%d_finish" % step)
+    tc.record("mystery_stamp")

@@ -1,0 +1,5 @@
+"""RNB-T007: stamps an attribute CONTENT_STAMPS does not declare."""
+
+
+def stamp(time_card):
+    time_card.mystery_attr = 1

@@ -1,0 +1,15 @@
+"""Clean hot-path fixture: no rule fires."""
+
+import math
+
+
+def helper(x):
+    return math.sqrt(x)
+
+
+class Stage:
+    def __call__(self, tensors, non_tensors, time_card):
+        total = 0
+        for pb in tensors:
+            total += helper(pb)
+        return tensors, non_tensors, time_card

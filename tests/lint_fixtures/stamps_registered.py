@@ -1,0 +1,8 @@
+"""Schema fixture: records exactly the registered stamp patterns."""
+
+
+def stamp_all(tc, step):
+    tc.record("enqueue_filename")
+    tc.record("runner%d_start" % step)
+    tc.record("inference%d_start" % step)
+    tc.record("inference%d_finish" % step)

@@ -324,7 +324,11 @@ def test_cache_composes_with_row_buckets():
                             num_clips_population=[2], weights=[1])
     video = "synth://kinetics/video-0021"
     emitted = []
-    loader(None, video, TimeCard(0))
+    # a fast decode can emit from __call__ itself (the internal poll),
+    # so the return value must be captured like any flush() emission
+    out = loader(None, video, TimeCard(0))
+    if out[2] is not None:
+        emitted.append(out)
     while True:
         out = loader.flush()
         if out is None:

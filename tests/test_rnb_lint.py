@@ -1,0 +1,228 @@
+"""Tier-1 gate for the static analyzer (scripts/rnb_lint.py).
+
+Three layers:
+
+* fixture pairs per rule — every ``bad_*`` fixture triggers exactly
+  its rule id, the ``good*`` fixtures trigger nothing;
+* the repo itself (rnb_tpu/ + every shipped config) is lint-clean
+  modulo the checked-in baseline, via the real CLI under
+  ``JAX_PLATFORMS=cpu`` with no device or dataset;
+* the schema checker's cross-checks fire on synthetic drift
+  (unparsed registry entries, BenchmarkResult counter drift).
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+# -- pipeline graph checker -------------------------------------------
+
+GRAPH_CASES = [
+    ("bad_g001_parse.json", "RNB-G001"),
+    ("bad_g002_class.json", "RNB-G002"),
+    ("bad_g003_shape.json", "RNB-G003"),
+    ("bad_g004_selector.json", "RNB-G004"),
+    ("bad_g005_key.json", "RNB-G005"),
+    ("bad_g006_buckets.json", "RNB-G006"),
+    ("bad_g007_cache.json", "RNB-G007"),
+    ("bad_g008_dtype.json", "RNB-G008"),
+]
+
+
+def test_good_config_fixture_is_clean():
+    from rnb_tpu.analysis.graph import check_config
+    assert check_config(_fixture("good.json")) == []
+
+
+@pytest.mark.parametrize("name,rule", GRAPH_CASES)
+def test_bad_config_fixture_triggers_exactly_its_rule(name, rule):
+    from rnb_tpu.analysis.graph import check_config
+    findings = check_config(_fixture(name))
+    assert findings, "expected a %s finding for %s" % (rule, name)
+    assert {f.rule for f in findings} == {rule}, \
+        "expected only %s, got: %s" % (
+            rule, [f.render() for f in findings])
+
+
+def test_every_shipped_config_passes_the_graph_checker():
+    from rnb_tpu.analysis.graph import check_configs
+    paths = sorted(glob.glob(os.path.join(REPO, "configs", "*.json")))
+    assert paths
+    findings = check_configs(paths)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- hot-path AST lint ------------------------------------------------
+
+HOTPATH_CASES = [
+    ("bad_h001_jit.py", "RNB-H001"),
+    ("bad_h002_import.py", "RNB-H002"),
+    ("bad_h003_loop_put.py", "RNB-H003"),
+    ("bad_h004_random.py", "RNB-H004"),
+    ("bad_h005_shed.py", "RNB-H005"),
+    ("bad_h006_sync.py", "RNB-H006"),
+]
+
+
+def test_good_hotpath_fixture_is_clean():
+    from rnb_tpu.analysis.hotpath import check_file
+    assert check_file(_fixture("good_hot.py"), root=FIXTURES) == []
+
+
+@pytest.mark.parametrize("name,rule", HOTPATH_CASES)
+def test_bad_hotpath_fixture_triggers_exactly_its_rule(name, rule):
+    from rnb_tpu.analysis.hotpath import check_file
+    findings = check_file(_fixture(name), root=FIXTURES)
+    assert findings, "expected a %s finding for %s" % (rule, name)
+    assert {f.rule for f in findings} == {rule}, \
+        "expected only %s, got: %s" % (
+            rule, [f.render() for f in findings])
+
+
+# -- telemetry schema checker -----------------------------------------
+
+def _parse_utils_src():
+    with open(os.path.join(REPO, "scripts", "parse_utils.py")) as f:
+        return f.read()
+
+
+def test_registered_stamps_fixture_is_clean():
+    from rnb_tpu.analysis.schema import check_stamps
+    findings = check_stamps([_fixture("stamps_registered.py")],
+                            _parse_utils_src(), root=FIXTURES)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_unregistered_stamp_triggers_t001():
+    from rnb_tpu.analysis.schema import check_stamps
+    findings = check_stamps([_fixture("bad_t001_stamp.py")],
+                            _parse_utils_src(), root=FIXTURES)
+    assert {f.rule for f in findings} == {"RNB-T001"}
+    assert findings[0].anchor == "mystery_stamp"
+
+
+def test_unregistered_content_stamp_triggers_t007():
+    from rnb_tpu.analysis.schema import check_content_stamps
+    findings = check_content_stamps([_fixture("bad_t007_content.py")],
+                                    root=FIXTURES)
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T007", "mystery_attr")}
+
+
+def test_dead_and_unparsed_registry_stamp(tmp_path):
+    # a registered stamp nothing records and parse_utils never read:
+    # both directions of the cross-check fire
+    from rnb_tpu.analysis.schema import check_stamps
+    from rnb_tpu.telemetry import STAMP_REGISTRY, StampSpec
+    registry = STAMP_REGISTRY + (
+        StampSpec("ghost_stamp", "nowhere", "never produced"),)
+    findings = check_stamps([_fixture("stamps_registered.py")],
+                            _parse_utils_src(), root=FIXTURES,
+                            registry=registry)
+    assert {(f.rule, f.anchor) for f in findings} == {
+        ("RNB-T003", "ghost_stamp"), ("RNB-T002", "ghost_stamp")}
+
+
+def test_unregistered_meta_line_triggers_t004(tmp_path):
+    from rnb_tpu.analysis.schema import check_meta_lines
+    bench = tmp_path / "bench_like.py"
+    bench.write_text('f.write("Args: %s\\n" % args)\n'
+                     'f.write("Termination flag: %d\\n" % flag)\n'
+                     'f.write("Faults: num_failed=%d\\n" % n)\n'
+                     'f.write("Failure reasons: %s\\n" % r)\n'
+                     'f.write("Shed sites: %s\\n" % s)\n'
+                     'f.write("Cache: hits=%d\\n" % h)\n'
+                     'f.write("Bogus line: %s\\n" % b)\n')
+    findings = check_meta_lines(str(bench), _parse_utils_src(),
+                                root=str(tmp_path))
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T004", "Bogus line:")}
+
+
+def test_unparsed_meta_line_triggers_t005(tmp_path):
+    from rnb_tpu.analysis.schema import check_meta_lines
+    from rnb_tpu.telemetry import META_LINE_REGISTRY, StampSpec
+    bench = tmp_path / "bench_like.py"
+    bench.write_text('f.write("Ghost: %s\\n" % g)\n')
+    registry = (StampSpec("Ghost:", "here", "written, never parsed"),)
+    findings = check_meta_lines(str(bench), "startswith nothing",
+                                root=str(tmp_path), registry=registry)
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T005", "Ghost:")}
+
+
+def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
+    from rnb_tpu.analysis.schema import check_benchmark_result
+    bench = tmp_path / "bench_like.py"
+    bench.write_text(
+        'f.write("Faults: num_failed=%d num_shed=%d num_retries=%d '
+        'num_bogus=%d\\n" % x)\n'
+        'f.write("Cache: hits=%d misses=%d inserts=%d evictions=%d '
+        'coalesced=%d oversize=%d bytes_resident=%d\\n" % y)\n')
+    findings = check_benchmark_result(str(bench), root=str(tmp_path))
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T006", "num_bogus")}
+
+
+def test_schema_checker_clean_on_repo():
+    from rnb_tpu.analysis.schema import check_repo
+    findings = check_repo(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- the real CLI over the real repo ----------------------------------
+
+def test_rnb_lint_cli_clean_on_repo_and_shipped_configs():
+    """Acceptance: `python scripts/rnb_lint.py` exits 0 on the repo +
+    all shipped configs, with no JAX device and no dataset."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RNB_TPU_DATA_ROOT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "rnb_lint.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_rnb_lint_cli_fails_on_bad_config_with_rule_id():
+    """Acceptance: non-zero exit on a bad fixture, naming its rule."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "rnb_lint.py"),
+         "--family", "graph",
+         "--config", _fixture("bad_g006_buckets.json")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RNB-G006" in proc.stdout
+
+
+def test_parse_utils_stamps_reference():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "parse_utils.py"), "--stamps"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for needle in ("runner{step}_start", "inference{step}_finish",
+                   "Cache:", "# <kind>"):
+        assert needle in proc.stdout
+
+
+def test_baseline_file_parses_and_documents_every_entry():
+    from rnb_tpu.analysis.findings import Baseline
+    baseline = Baseline.load(os.path.join(REPO, "rnb-lint-baseline.txt"))
+    assert not baseline.empty()
+    for key, justification in baseline.entries.items():
+        assert justification, "baseline entry %r needs a justification" \
+            % (key,)

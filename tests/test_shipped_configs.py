@@ -58,3 +58,21 @@ def test_every_shipped_config_has_an_ok_execution_row():
         "configs whose last end-to-end execution failed: %s (see "
         "MULTICHIP_CONFIGS.json for the error rows)" % failed)
     assert artifact["all_ok"] is True
+
+
+def test_every_executed_config_is_still_shipped():
+    """The reverse direction: MULTICHIP_CONFIGS.json and configs/ stay
+    in sync BOTH ways. A row for a config that no longer ships is a
+    stale execution claim — it reads as coverage for a topology the
+    tree no longer contains (delete the row when retiring a config, or
+    restore the config)."""
+    with open(ARTIFACT) as f:
+        artifact = json.load(f)
+    shipped = {
+        os.path.relpath(p, REPO)
+        for p in glob.glob(os.path.join(REPO, "configs", "*.json"))}
+    stale = sorted({r["config"] for r in artifact["configs"]} - shipped)
+    assert not stale, (
+        "MULTICHIP_CONFIGS.json rows for configs that no longer ship: "
+        "%s — prune the rows (scripts/run_shipped_configs.py rewrites "
+        "the artifact) or restore the configs" % stale)
