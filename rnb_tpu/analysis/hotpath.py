@@ -40,6 +40,13 @@ Rules
   ``jax``/``jnp`` expression in a hot function — a deliberate sync
   belongs in the baseline with its justification, everything else is
   a stall of the executor thread.
+* ``RNB-H007`` bucket-alloc-per-emission: ``np.empty``/``np.zeros``
+  of a bucket/batch shape (an argument referencing a
+  ``_batch_shape``-style helper) in a hot function — a fresh
+  bucket-shaped host allocation per request/emission is the staging
+  anti-pattern PR 4 removed; decode into a ``rnb_tpu.staging``
+  StagingPool slot instead, and baseline the copy fallback with its
+  justification.
 """
 
 from __future__ import annotations
@@ -267,6 +274,22 @@ def _lint_jit_body(rel: str, qual: str, node, findings: List[Finding]
 _LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
                ast.SetComp, ast.DictComp, ast.GeneratorExp)
 
+#: helper names whose result is a bucket/batch shape — an np.empty/
+#: np.zeros over one of these on a hot path is a per-emission staging
+#: allocation (RNB-H007)
+_BATCH_SHAPE_HELPERS = {"_batch_shape", "batch_shape", "bucket_shape"}
+
+
+def _bucket_alloc_kind(node: ast.Call) -> Optional[str]:
+    """Classify one call as a bucket-shaped host allocation, or None."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("empty", "zeros") \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id in _NP_NAMES and node.args:
+        if _attr_chain_has(node.args[0], _BATCH_SHAPE_HELPERS):
+            return "np.%s() of a bucket shape" % f.attr
+    return None
+
 
 def _lint_hot_body(rel: str, qual: str, node,
                    findings: List[Finding]) -> None:
@@ -303,6 +326,14 @@ def _lint_hot_body(rel: str, qual: str, node,
                     "%s on a hot path stalls the executor thread — fix "
                     "it, or baseline it with the justification"
                     % kind))
+            alloc = _bucket_alloc_kind(sub)
+            if alloc is not None:
+                findings.append(Finding(
+                    "RNB-H007", rel, sub.lineno, qual,
+                    "%s on a per-emission loader path — decode into a "
+                    "staging slot (rnb_tpu.staging) instead, or "
+                    "baseline the copy fallback with its justification"
+                    % alloc))
 
 
 def _lint_fault_determinism(rel: str, index: _ModuleIndex,

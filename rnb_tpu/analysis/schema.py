@@ -25,9 +25,9 @@ Rules
 * ``RNB-T005`` unparsed-meta-or-trailer: a registered meta-line prefix
   or trailer kind ``parse_utils`` never checks for.
 * ``RNB-T006`` result-field-drift: a ``key=value`` counter written to
-  the Faults:/Cache: log-meta lines with no matching
-  ``BenchmarkResult`` field (or vice versa for the cache/fault field
-  families).
+  the Faults:/Cache:/Staging: log-meta lines with no matching
+  ``BenchmarkResult`` field (or vice versa for the cache/fault/staging
+  field families).
 * ``RNB-T007`` unregistered-content-stamp: an attribute stamped onto a
   TimeCard (``time_card.x = ...``) that is neither a core TimeCard
   attribute nor declared in ``CONTENT_STAMPS`` — it would silently
@@ -197,9 +197,16 @@ def extract_trailer_kinds(telemetry_path: str, root: str = "."
     return out
 
 
+#: counter-carrying log-meta lines and the BenchmarkResult field
+#: prefix their ``key=value`` tokens map to (the same mapping
+#: parse_utils applies when flattening the meta dict)
+COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
+                         "Staging:": "staging_"}
+
+
 def extract_meta_counter_keys(benchmark_path: str) -> Dict[str, Set[str]]:
-    """``key=value`` counter names inside the Faults:/Cache: log-meta
-    format strings: -> {"Faults:": {...}, "Cache:": {...}}."""
+    """``key=value`` counter names inside the Faults:/Cache:/Staging:
+    log-meta format strings: -> {"Faults:": {...}, ...}."""
     keys: Dict[str, Set[str]] = {}
     key_re = re.compile(r"(\w+)=%")
     for node in ast.walk(_parse(benchmark_path)):
@@ -209,7 +216,7 @@ def extract_meta_counter_keys(benchmark_path: str) -> Dict[str, Set[str]]:
             literal = _fmt_string(node.args[0])
             if literal is None:
                 continue
-            for prefix in ("Faults:", "Cache:"):
+            for prefix in COUNTER_LINE_PREFIXES:
                 if literal.startswith(prefix):
                     keys.setdefault(prefix, set()).update(
                         key_re.findall(literal))
@@ -335,7 +342,7 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
     mapped: Set[str] = set()
     for prefix, keys in sorted(written.items()):
         for key in sorted(keys):
-            field = key if prefix == "Faults:" else "cache_" + key
+            field = COUNTER_LINE_PREFIXES[prefix] + key
             mapped.add(field)
             if field not in fields:
                 findings.append(Finding(
@@ -348,13 +355,14 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
     # parsing (parse_utils reads log-meta, not BenchmarkResult)
     for field in sorted(fields):
         if field in ("num_failed", "num_shed", "num_retries") \
-                or field.startswith("cache_"):
+                or field.startswith("cache_") \
+                or field.startswith("staging_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,
                     "BenchmarkResult.%s has no matching counter in "
-                    "the Faults:/Cache: log-meta lines — offline "
-                    "parsing cannot recover it" % field))
+                    "the Faults:/Cache:/Staging: log-meta lines — "
+                    "offline parsing cannot recover it" % field))
     return findings
 
 

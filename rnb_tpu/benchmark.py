@@ -98,6 +98,20 @@ class BenchmarkResult:
     #: the schema checker's BenchmarkResult cross-check caught it)
     cache_oversize: int = 0
     cache_bytes_resident: int = 0
+    #: zero-copy decode-staging accounting (rnb_tpu.staging), summed
+    #: over every staging-owning stage instance; all zero when no
+    #: loader built a pool (staging_slots=0 / non-native backend).
+    #: staged vs copied batches split the emissions between the
+    #: zero-copy slot path and the seed copy fallback; acquire_waits
+    #: counts backpressure blocks on slot exhaustion (never drops);
+    #: reallocs counts alias-forced slot-buffer replacements.
+    staging_slots: int = 0
+    staging_slot_bytes: int = 0
+    staging_acquires: int = 0
+    staging_acquire_waits: int = 0
+    staging_staged_batches: int = 0
+    staging_copied_batches: int = 0
+    staging_reallocs: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -147,6 +161,7 @@ def run_benchmark(config_path: str,
     termination = TerminationState()
     summary_sink: list = []
     cache_sink: list = []
+    staging_sink: list = []
     fault_stats = FaultStats()
     fault_plan = FaultPlan.resolve(config.fault_plan)
     if fault_plan is not None:
@@ -237,6 +252,7 @@ def run_benchmark(config_path: str,
                     fault_plan=fault_plan,
                     fault_stats=fault_stats,
                     cache_sink=cache_sink,
+                    staging_sink=staging_sink,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -354,6 +370,11 @@ def run_benchmark(config_path: str,
     if cache_sink:
         from rnb_tpu.cache import aggregate_snapshots
         cache_stats = aggregate_snapshots(cache_sink)
+    staging_stats = None
+    if staging_sink:
+        from rnb_tpu.staging import aggregate_snapshots as \
+            aggregate_staging
+        staging_stats = aggregate_staging(staging_sink)
 
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
@@ -389,6 +410,19 @@ def run_benchmark(config_path: str,
                        cache_stats["inserts"], cache_stats["evictions"],
                        cache_stats["coalesced"], cache_stats["oversize"],
                        cache_stats["bytes_resident"]))
+        if staging_stats is not None:
+            # only staging-enabled runs carry the line, keeping
+            # staging-free logs byte-stable with the earlier schema
+            f.write("Staging: slots=%d slot_bytes=%d acquires=%d "
+                    "acquire_waits=%d staged_batches=%d "
+                    "copied_batches=%d reallocs=%d\n"
+                    % (staging_stats["slots"],
+                       staging_stats["slot_bytes"],
+                       staging_stats["acquires"],
+                       staging_stats["acquire_waits"],
+                       staging_stats["staged_batches"],
+                       staging_stats["copied_batches"],
+                       staging_stats["reallocs"]))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -429,6 +463,16 @@ def run_benchmark(config_path: str,
                  100.0 * cache_stats["hits"] / lookups if lookups else 0.0,
                  cache_stats["coalesced"], cache_stats["evictions"],
                  cache_stats["bytes_resident"] / (1 << 20)))
+    if staging_stats is not None and print_progress:
+        emissions = (staging_stats["staged_batches"]
+                     + staging_stats["copied_batches"])
+        print("Staging: %d/%d emissions zero-copy, %d slot(s) "
+              "(%.1f MiB), %d acquire wait(s), %d realloc(s)"
+              % (staging_stats["staged_batches"], emissions,
+                 staging_stats["slots"],
+                 staging_stats["slot_bytes"] / (1 << 20),
+                 staging_stats["acquire_waits"],
+                 staging_stats["reallocs"]))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -470,6 +514,19 @@ def run_benchmark(config_path: str,
         cache_oversize=cache_stats["oversize"] if cache_stats else 0,
         cache_bytes_resident=(cache_stats["bytes_resident"]
                               if cache_stats else 0),
+        staging_slots=staging_stats["slots"] if staging_stats else 0,
+        staging_slot_bytes=(staging_stats["slot_bytes"]
+                            if staging_stats else 0),
+        staging_acquires=(staging_stats["acquires"]
+                          if staging_stats else 0),
+        staging_acquire_waits=(staging_stats["acquire_waits"]
+                               if staging_stats else 0),
+        staging_staged_batches=(staging_stats["staged_batches"]
+                                if staging_stats else 0),
+        staging_copied_batches=(staging_stats["copied_batches"]
+                                if staging_stats else 0),
+        staging_reallocs=(staging_stats["reallocs"]
+                          if staging_stats else 0),
     )
 
 

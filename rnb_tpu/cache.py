@@ -172,6 +172,15 @@ class ClipCache:
         reuse, so the insert pays one extra transfer the first time a
         video is seen (amortized away by every later hit; the
         ``loader.cache_insert`` hostprof section accounts for it).
+
+        Staging contract (rnb_tpu.staging): ``clips`` may be a view
+        into a staging slot whose buffer is recycled after the fused
+        emission's transfer confirms. This method COPIES the rows into
+        its own freshly padded array before any transfer, so it must
+        be called while the slot rows are still live (the fusing
+        loader inserts during ``_emit``, strictly before the slot's
+        transfer handoff) — after that, the cached device array owns
+        independent bytes and can never observe a slot reuse.
         """
         if int(np.prod(target_shape)) > self.capacity_bytes:
             with self._lock:

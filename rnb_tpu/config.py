@@ -245,6 +245,30 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 "%s: 'row_buckets' cannot be combined with "
                 "'num_segments' > 1" % where)
 
+        # transfer-pipeline knobs (rnb_tpu.staging) are open kwargs —
+        # they flow to the stage constructor like any extra — but
+        # their types are validated here so a typo'd value fails at
+        # parse time, not as a mid-run constructor error
+        staging_slots = step_raw.get("staging_slots")
+        _expect(staging_slots is None
+                or (isinstance(staging_slots, int)
+                    and not isinstance(staging_slots, bool)
+                    and staging_slots >= 0),
+                "%s: 'staging_slots' must be a non-negative integer "
+                "(0 disables zero-copy staging), got %r"
+                % (where, staging_slots))
+        transfer_async = step_raw.get("transfer_async")
+        _expect(transfer_async is None or isinstance(transfer_async, bool),
+                "%s: 'transfer_async' must be a boolean, got %r"
+                % (where, transfer_async))
+        fallback_threads = step_raw.get("fallback_decode_threads")
+        _expect(fallback_threads is None
+                or (isinstance(fallback_threads, int)
+                    and not isinstance(fallback_threads, bool)
+                    and fallback_threads >= 1),
+                "%s: 'fallback_decode_threads' must be a positive "
+                "integer, got %r" % (where, fallback_threads))
+
         num_shared_tensors = step_raw.get("num_shared_tensors")
         if num_shared_tensors is not None:
             _expect(isinstance(num_shared_tensors, int)

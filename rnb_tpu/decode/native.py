@@ -113,6 +113,16 @@ def native_available() -> bool:
     return load_native() is not None
 
 
+def default_decode_threads() -> int:
+    """The one decode-pool sizing rule: ``RNB_DECODE_THREADS`` env
+    override, else min(8, cores). Shared by the native
+    :class:`DecodePool` and the loaders' non-native Python fallback
+    pool (rnb_tpu/models/r2p1d/model.py ``fallback_decode_threads``),
+    so the two backends degrade with identical parallelism."""
+    return int(os.environ.get("RNB_DECODE_THREADS",
+                              min(8, os.cpu_count() or 1)))
+
+
 def _check(rc: int, path: str) -> None:
     """Raise the native error code as a *classified* exception
     (rnb_tpu.faults): -1 (read failed; may succeed on retry) is
@@ -146,8 +156,7 @@ class DecodePool:
             raise RuntimeError("native decode library not built; run "
                                "`make -C native`")
         if num_threads is None:
-            num_threads = int(os.environ.get("RNB_DECODE_THREADS",
-                                             min(8, os.cpu_count() or 1)))
+            num_threads = default_decode_threads()
         self._lib = lib
         self._pool = lib.rnb_pool_create(int(num_threads))
         self.num_threads = int(num_threads)

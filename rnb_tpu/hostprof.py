@@ -86,6 +86,22 @@ def snapshot() -> Dict[str, Tuple[float, int]]:
         return {k: (v[0], v[1]) for k, v in _acc.items()}
 
 
+def totals(prefix: str) -> Tuple[float, int]:
+    """Summed ``(seconds, calls)`` over sections whose name starts
+    with ``prefix`` — e.g. ``totals("loader.emit")`` for the whole
+    emission-assembly family, or ``totals("transfer.")`` for the
+    transfer-worker thread. The staging acceptance comparison
+    (executor-thread ``loader.device_put`` + emit alloc/copy share,
+    RESULTS.md round 5) is a prefix sum like this."""
+    with _lock:
+        total_s, calls = 0.0, 0
+        for name, (secs, n) in _acc.items():
+            if name.startswith(prefix):
+                total_s += secs
+                calls += n
+        return total_s, calls
+
+
 def report_lines(wall_s: float) -> List[str]:
     """Human table: per-section total seconds, share of the window,
     call count and per-call mean, sorted by total."""

@@ -31,7 +31,8 @@ from rnb_tpu import hostprof
 from rnb_tpu.cache import content_key
 from rnb_tpu.decode import get_decoder
 from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_RGB,
-                                   PIX_YUV420)
+                                   PIX_YUV420, default_decode_threads,
+                                   native_available)
 from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
 from rnb_tpu.models.r2p1d import checkpoint as ckpt
 from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
@@ -42,6 +43,7 @@ from rnb_tpu.models.r2p1d.sampler import R2P1DSampler
 from rnb_tpu.ops.yuv import packed_frame_bytes
 from rnb_tpu.selector import QueueSelector
 from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
+from rnb_tpu.staging import StagingPool, TransferWorker
 from rnb_tpu.telemetry import TimeCard, TimeCardList
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 from rnb_tpu import video_path_provider
@@ -152,10 +154,11 @@ class _DecodeHandle:
     """
 
     __slots__ = ("out", "n", "pool", "tickets", "future", "cached",
-                 "leader", "key", "error")
+                 "leader", "key", "error", "slot", "row0")
 
     def __init__(self, out, n, pool=None, tickets=None, future=None,
-                 cached=None, leader=None, key=None):
+                 cached=None, leader=None, key=None, slot=None,
+                 row0=0):
         self.out = out          # uint8 (n, F, H, W, 3), filled async
         self.n = n              # valid clip count
         self.pool = pool        # the DecodePool the tickets belong to
@@ -165,6 +168,8 @@ class _DecodeHandle:
         self.leader = leader    # coalesced: the leader's handle, or None
         self.key = key          # cache key of this decode, or None
         self.error = None       # sticky decode failure (see class doc)
+        self.slot = slot        # StagingSlot the decode targets, or None
+        self.row0 = row0        # first row of this decode in the slot
 
     def wait(self, video: str = "<video>") -> None:
         if self.leader is not None:
@@ -222,6 +227,12 @@ class R2P1DLoader(StageModel):
     *residual* wait, which is exactly the overlap being bought.
     """
 
+    #: transfer_async moves ``device_put`` to a dedicated worker thread
+    #: between emissions — only meaningful for a stage that emits
+    #: asynchronously of its model call (the fusing loader); the plain
+    #: loader's complete() contract is synchronous
+    SUPPORTS_TRANSFER_ASYNC = False
+
     def __init__(self, device, max_clips: int = MAX_CLIPS,
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
                  num_clips_population=None, weights=None,
@@ -229,6 +240,8 @@ class R2P1DLoader(StageModel):
                  raw_output: bool = False,
                  row_buckets=None, prefetch: int = 0,
                  pixel_path: str = "rgb", cache_mb: float = 0,
+                 staging_slots=None, transfer_async: bool = False,
+                 fallback_decode_threads=None,
                  **kwargs):
         super().__init__(device)
         import jax
@@ -277,7 +290,55 @@ class R2P1DLoader(StageModel):
                              "clip axis")
         self.prefetch_depth = int(prefetch)
         self._fallback_pool = None  # lazily built thread pool
+        # non-native fallback decode pool sizing: defaults to the
+        # native DecodePool rule (RNB_DECODE_THREADS env, else
+        # min(8, cores)) instead of a hardcoded width
+        if fallback_decode_threads is None:
+            self.fallback_decode_threads = default_decode_threads()
+        else:
+            self.fallback_decode_threads = int(fallback_decode_threads)
+            if self.fallback_decode_threads < 1:
+                raise ValueError("fallback_decode_threads must be >= 1, "
+                                 "got %r" % (fallback_decode_threads,))
         self._starts_cache = {}  # video -> clip starts (see _sample_starts)
+        # Zero-copy decode staging (rnb_tpu.staging): pre-allocated
+        # host slots the native decoder writes straight into, removing
+        # the per-request/per-emission bucket-shaped allocation and
+        # assembly memcpy from the hot path. staging_slots=0 disables
+        # (the seed copy path); None auto-sizes per loader kind.
+        self.transfer_async = bool(transfer_async)
+        if self.transfer_async and not self.SUPPORTS_TRANSFER_ASYNC:
+            raise ValueError(
+                "transfer_async requires a stage that emits "
+                "asynchronously (R2P1DFusingLoader); %s completes "
+                "requests synchronously" % type(self).__name__)
+        if staging_slots is not None:
+            staging_slots = int(staging_slots)
+            if staging_slots < 0:
+                raise ValueError("staging_slots must be >= 0 "
+                                 "(0 disables staging), got %r"
+                                 % (staging_slots,))
+        slots = (self._staging_default_slots() if staging_slots is None
+                 else staging_slots)
+        self.staging = None
+        if slots and native_available() \
+                and self._staging_default_slots() > 0:
+            # floor the explicit knob at the loader's structural
+            # minimum: the plain loader's submit window holds
+            # prefetch+1 slots before the first complete() (same
+            # thread) can release one, so fewer than prefetch+2 slots
+            # would deadlock submit against itself. The fusing loader
+            # pressure-drains in _acquire_fused_slot and works at 1.
+            slots = max(slots, self._staging_min_slots())
+            # the zero-copy path exists only for the native decoder
+            # (submit_into writes caller buffers) and only on code
+            # paths that decode into caller targets — a plain loader
+            # without prefetch decodes synchronously in __call__ and
+            # would never touch a pool, so an explicit staging_slots
+            # is ignored there (default_slots()==0) rather than
+            # allocating dead slots and reporting misleading Staging:
+            # telemetry. Non-native backends keep the copy fallback.
+            self.staging = StagingPool(self._staging_shapes(), slots)
         # Device-resident decoded-clip cache + in-flight coalescing
         # (rnb_tpu.cache): opt-in per config via `cache_mb`. The cached
         # value is the padded on-device uint8 batch (post-device_put,
@@ -359,6 +420,43 @@ class R2P1DLoader(StageModel):
                     raise
                 print("[rnb-tpu] WARNING: decode warm-up skipped %s: %s"
                       % (path, e))
+
+    def _staging_default_slots(self) -> int:
+        """Auto slot budget: the prefetch window plus one transferring
+        slot (submit must never deadlock waiting on a complete() that
+        runs later on the same executor thread). 0 = no pool: without
+        prefetch the plain loader decodes synchronously in __call__
+        and never targets a slot."""
+        return self.prefetch_depth + 2 if self.prefetch_depth > 0 else 0
+
+    def _staging_min_slots(self) -> int:
+        """Smallest slot count this loader can run without submit
+        deadlocking against its own complete() (see __init__)."""
+        return self.prefetch_depth + 2
+
+    def _staging_shapes(self):
+        """One sub-pool per emitted bucket shape."""
+        return [self._batch_shape(b) for b in self.row_buckets]
+
+    def _stage_target(self, n: int):
+        """Decode-target buffer for one native request:
+        ``(buffer, slot, row0)`` — a staging-slot row view on the
+        zero-copy path, or a fresh allocation when staging is off
+        (the copy fallback, baselined under RNB-H007)."""
+        if self.staging is not None:
+            slot = self.staging.acquire(
+                self._batch_shape(self._bucket_for(n)))
+            self.staging.add_ref(slot)
+            return slot.buf[:n], slot, 0
+        return np.empty(self._batch_shape(n), dtype=np.uint8), None, 0
+
+    def _release_handle_slot(self, handle) -> None:
+        """Retire a handle's staging-slot reference (idempotent): its
+        rows are consumed, dead, or replaced by a re-decode."""
+        slot = getattr(handle, "slot", None)
+        if slot is not None and self.staging is not None:
+            self.staging.retire_ref(slot)
+            handle.slot = None
 
     def _decode_sync(self, decoder, video, starts):
         """Synchronous decode through this loader's pixel path."""
@@ -488,7 +586,16 @@ class R2P1DLoader(StageModel):
                 time_card.num_clips = leader.n
                 time_card.cache_coalesced = True
                 self.cache.note_coalesced()
-                return _DecodeHandle(None, leader.n, leader=leader)
+                follower = _DecodeHandle(None, leader.n, leader=leader)
+                if leader.slot is not None and self.staging is not None:
+                    # the follower reads the leader's slot rows for its
+                    # own transfer — it must hold its own reference or
+                    # the leader's completion could recycle the slot
+                    # under the follower's still-pending read
+                    self.staging.add_ref(leader.slot)
+                    follower.slot = leader.slot
+                    follower.row0 = leader.row0
+                return follower
         handle = self._decode_submit(video, time_card)
         if key is not None:
             handle.key = key
@@ -509,7 +616,7 @@ class R2P1DLoader(StageModel):
         # to the native pool anyway would kill the run the synchronous
         # path survives
         if isinstance(decoder, NativeY4MDecoder):
-            out = np.empty(self._batch_shape(n), dtype=np.uint8)
+            out, slot, row0 = self._stage_target(n)
             pixfmt = (PIX_YUV420 if self.pixel_path == "yuv420"
                       else PIX_RGB)
             pool = DecodePool.shared()
@@ -527,16 +634,20 @@ class R2P1DLoader(StageModel):
                 # un-waited tickets pin the batch buffer in the pool's
                 # pending map for the process's life
                 partial = _DecodeHandle(out, n, pool=pool,
-                                        tickets=tickets)
+                                        tickets=tickets, slot=slot,
+                                        row0=row0)
                 try:
                     partial.wait(video)
                 except ValueError:
                     pass
+                self._release_handle_slot(partial)
                 raise
-            return _DecodeHandle(out, n, pool=pool, tickets=tickets)
+            return _DecodeHandle(out, n, pool=pool, tickets=tickets,
+                                 slot=slot, row0=row0)
         if self._fallback_pool is None:
             self._fallback_pool = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="rnb-decode")
+                max_workers=self.fallback_decode_threads,
+                thread_name_prefix="rnb-decode")
 
         handle = _DecodeHandle(None, n)
 
@@ -579,6 +690,37 @@ class R2P1DLoader(StageModel):
         batch = self._preprocess(device_u8)
         return (PaddedBatch(batch, n),), None, time_card
 
+    def _materialize_slot(self, handle: _DecodeHandle, time_card,
+                          cache_key=None):
+        """The staged twin of :meth:`_materialize`: the decode landed
+        directly in a bucket-shaped staging slot, so the slot IS the
+        transfer buffer — no pad allocation, no assembly copy. Only
+        the padding tail is zeroed (seed byte parity), the transfer is
+        confirmed lazily at the slot's next acquire, and the slot is
+        recycled strictly after that confirmation (rnb_tpu.staging
+        alias handling keeps an aliasing backend from ever reusing
+        memory a live device batch still reads)."""
+        jax, _ = _jax_numpy()
+        slot, n = handle.slot, handle.n
+        if n < slot.buf.shape[0]:
+            slot.buf[n:] = 0
+        self.staging.begin_transfer(slot)
+        with hostprof.section("loader.device_put"):
+            device_u8 = jax.device_put(slot.buf, self._jax_device)
+        self.staging.finish_transfer(slot, device_u8)
+        self.staging.note_staged()
+        self._release_handle_slot(handle)
+        if cache_key is not None and self.cache is not None:
+            # still zero-copy: the cached device array owns its bytes
+            # once the transfer is confirmed; the slot recycle gate
+            # (and the alias probe behind it) guarantees exactly that
+            with hostprof.section("loader.cache_insert"):
+                self.cache.insert_device(cache_key, device_u8, n)
+        if self._preprocess is None:
+            return (PaddedBatch(device_u8, n),), None, time_card
+        return (PaddedBatch(self._preprocess(device_u8), n),), None, \
+            time_card
+
     def complete(self, handle: _DecodeHandle, non_tensors, time_card):
         """Wait for a submitted decode, then pad/transfer/normalize
         (or serve the cached/coalesced result without decode work)."""
@@ -589,25 +731,42 @@ class R2P1DLoader(StageModel):
             # leader re-raises its classified error here (containment
             # then dead-letters this request too). No cache insert —
             # the leader already did it.
-            handle.wait(str(non_tensors))
+            try:
+                handle.wait(str(non_tensors))
+            except Exception:
+                self._release_handle_slot(handle)
+                raise
+            if handle.slot is not None:
+                # the follower pays its own transfer straight from the
+                # leader's slot rows (its own reference keeps them live)
+                return self._materialize_slot(handle, time_card)
             return self._materialize(handle.out, handle.n, time_card)
         try:
             handle.wait(str(non_tensors))
+        except Exception:
+            self._release_handle_slot(handle)
+            raise
         finally:
             # the decode is finalized either way: later requests for
             # this key consult the cache (success) or decode afresh
             if self._inflight_keys is not None:
                 self._inflight_keys.pop(handle.key)
+        if handle.slot is not None:
+            return self._materialize_slot(handle, time_card,
+                                          cache_key=handle.key)
         return self._materialize(handle.out, handle.n, time_card,
                                  cache_key=handle.key)
 
     def discard(self, handle: _DecodeHandle, non_tensors=None) -> None:
         """Retire a submitted decode whose result will never be used
-        (abort path) so native tickets don't pin buffers forever."""
+        (abort path) so native tickets don't pin buffers forever —
+        and release its staging-slot reference, so a contained or
+        aborted request can never leak a slot."""
         try:
             handle.wait(str(non_tensors))
         except Exception:
             pass  # abort path: decode errors are moot
+        self._release_handle_slot(handle)
         if self._inflight_keys is not None:
             self._inflight_keys.pop(getattr(handle, "key", None))
 
@@ -673,7 +832,26 @@ class R2P1DFusingLoader(R2P1DLoader):
     Reference lineage: batcher.py:17-34 (the fixed-k Batcher) +
     README.md:46-110 (NVVL's async loadfile) — fused into one stage
     the way NVVL fused sampling+decode+batch assembly.
+
+    **Zero-copy staging + transfer pipeline** (rnb_tpu.staging): with
+    a staging pool (default on over the native decoder), submit-time
+    row planning makes the decode pool write each request directly
+    into its slice of a pre-allocated slot — a full take emits the
+    slot's bucket prefix with no allocation and no assembly copy —
+    and ``transfer_async`` moves the ``device_put`` to a dedicated
+    worker so batch N transfers while batch N+1 decodes. Completed
+    emissions surface through :meth:`take_ready`, which the executor
+    drains ahead of new input. README "Transfer pipeline".
     """
+
+    #: emissions happen between model calls, so device_put can move to
+    #: the transfer worker without breaking any synchronous contract
+    SUPPORTS_TRANSFER_ASYNC = True
+
+    #: default staging depth: one slot filling with planned decodes,
+    #: one transferring, one spare so a hold-timeout partial emission
+    #: cannot stall planning (double/triple buffering)
+    DEFAULT_STAGING_SLOTS = 3
 
     def __init__(self, device, fuse: int = 6, depth: Optional[int] = None,
                  max_hold_ms: float = 5.0, **kwargs):
@@ -689,6 +867,20 @@ class R2P1DFusingLoader(R2P1DLoader):
         self.max_hold_ms = float(max_hold_ms)
         self._inflight = deque()  # _FuseRecord, decode still running
         self._ready = deque()     # _FuseRecord, decode complete
+        # -- zero-copy staging + transfer pipeline (rnb_tpu.staging) --
+        #: the one slot shape fused planning targets: buckets are
+        #: emitted as C-contiguous row prefixes of the max shape
+        self._slot_shape = self._batch_shape(self.max_clips)
+        self._open_slot = None   # slot currently accepting row plans
+        self._open_rows = 0      # rows planned into the open slot
+        self._open_count = 0     # requests planned into the open slot
+        #: completed emissions awaiting pickup (take_ready/poll/flush);
+        #: appended by the transfer worker under transfer_async
+        self._out_ready = deque()
+        self._out_lock = threading.Lock()
+        self._worker = None
+        if self.transfer_async:
+            self._worker = TransferWorker(pool=self.staging)
         # requests whose decode failed with a *classified* error while
         # their batch was being assembled: (time_card, reason), drained
         # by the executor's take_failed() protocol (rnb_tpu.runner)
@@ -719,9 +911,71 @@ class R2P1DFusingLoader(R2P1DLoader):
 
     def _park_failed(self, rec: "_FuseRecord", reason: str) -> None:
         """Every card riding this record — leader and coalesced
-        followers — fails as a unit; none is ever cached."""
+        followers — fails as a unit; none is ever cached. A contained
+        failure releases its staging-slot rows (the slot recycles once
+        its surviving batchmates are through)."""
         self._drop_coalesce(rec)
+        self._release_handle_slot(rec.handle)
         self._failed.extend((tc, reason) for tc in rec.cards)
+
+    def _staging_default_slots(self) -> int:
+        return self.DEFAULT_STAGING_SLOTS
+
+    def _staging_min_slots(self) -> int:
+        # _acquire_fused_slot frees slots by emitting before it ever
+        # blocks, so even a single slot cannot self-deadlock
+        return 1
+
+    def _staging_shapes(self):
+        # fused emissions ship bucket-sized row prefixes of ONE slot
+        # shape — smaller buckets are contiguous prefix views, so no
+        # per-bucket sub-pools are needed
+        return [self._batch_shape(self.max_clips)]
+
+    def _stage_target(self, n: int):
+        """Submit-time row planning: place this request's rows into
+        the open staging slot so the native pool decodes straight into
+        its final position in the fused batch. The slot seals (next
+        request opens a fresh one) exactly on the emission take rules
+        — ``fuse`` requests or the row cap — so a full take is a
+        contiguous row prefix and ships zero-copy."""
+        if self.staging is None:
+            return super()._stage_target(n)
+        cap = self.max_clips
+        if (self._open_slot is None or self._open_count >= self.fuse
+                or self._open_rows + n > cap):
+            self._open_slot = self._acquire_fused_slot()
+            self._open_rows = 0
+            self._open_count = 0
+        slot = self._open_slot
+        row0 = self._open_rows
+        self.staging.add_ref(slot)
+        self._open_rows += n
+        self._open_count += 1
+        return slot.buf[row0:row0 + n], slot, row0
+
+    def _acquire_fused_slot(self):
+        """A fresh slot for planning. On exhaustion, free slots by
+        finishing our own work first (retire the oldest decode, emit)
+        — the emission path is what releases slots, and it runs on
+        this same executor thread, so blocking before draining would
+        be a self-deadlock. Only when every slot is held by an
+        in-flight transfer does this block (counted backpressure,
+        bounded by the transfer worker)."""
+        slot = self.staging.try_acquire(self._slot_shape)
+        while slot is None:
+            if self._inflight or self._ready:
+                if not self._ready and self._inflight:
+                    rec = self._inflight.popleft()
+                    if self._wait_contained(rec):
+                        rec.t_ready = time.monotonic()
+                        self._ready.append(rec)
+                self._harvest()
+                self._emit()
+                slot = self.staging.try_acquire(self._slot_shape)
+                continue
+            slot = self.staging.acquire(self._slot_shape)
+        return slot
 
     def _wait_contained(self, rec: "_FuseRecord") -> bool:
         """Wait one decode; True on success. A *transient* failure
@@ -755,6 +1009,10 @@ class R2P1DFusingLoader(R2P1DLoader):
                         handle.out = self._decode_sync(decoder, video,
                                                        starts)
                         handle.error = None  # recovered (sticky wait)
+                        # the re-decode owns a fresh buffer; the slot
+                        # rows are dead (the emission for this record
+                        # takes the copy path)
+                        self._release_handle_slot(handle)
                         return True
                     except Exception as e2:
                         kind2 = classify_error(e2)
@@ -783,13 +1041,17 @@ class R2P1DFusingLoader(R2P1DLoader):
         n, self._stage_retries = self._stage_retries, 0
         return n
 
-    def _emit(self):
+    def _emit(self) -> bool:
         """Fuse ready requests (up to ``fuse`` / the ring max rows)
-        into one padded batch + TimeCardList — or None when every
-        taken request's decode failed (the failures are on the
-        take_failed() queue)."""
-        jax, _ = _jax_numpy()
-
+        into one padded batch + TimeCardList and ship it — zero-copy
+        straight from the staging slot when the take is the slot's
+        contiguous row prefix, else through the seed copy path. The
+        finished emission lands on the ready queue (``_pop_ready``):
+        synchronously after the inline transfer, or from the transfer
+        worker under ``transfer_async``. Returns True when ready
+        records were consumed (progress), False when nothing was
+        takeable; a take whose every decode failed still returns True
+        (the failures are on the take_failed() queue)."""
         cap = self.max_clips
         take, rows = [], 0
         while self._ready and len(take) < self.fuse:
@@ -803,38 +1065,35 @@ class R2P1DFusingLoader(R2P1DLoader):
             self._drop_coalesce(rec)
             take.append(rec)
             rows += handle.n
+        if not take:
+            return False
         # the take loop guarantees this (submit caps each request at
         # max_clips); a silent min() here would mask clip loss instead
         # of surfacing the broken invariant
         assert rows <= cap, (rows, cap)
+        for rec in take:
+            if rec.handle.slot is not None \
+                    and rec.handle.slot is self._open_slot:
+                # taking from the open slot seals it: later submits
+                # must not plan rows into a buffer that is about to
+                # be (or already is) handed to a transfer
+                self._open_slot = None
+                break
         ok = []
-        with hostprof.section("loader.emit_wait+copy"):
+        with hostprof.section("loader.emit_wait"):
             for rec in take:
                 if self._wait_contained(rec):
                     ok.append(rec)
         if not ok:
-            return None
+            return True
         rows = sum(rec.handle.n for rec in ok)
         bucket = self._bucket_for(rows)
-        with hostprof.section("loader.emit_alloc"):
-            # rows [0, row) are overwritten below; only the padding
-            # tail needs zeroing (a full np.zeros cost 4.3% of the
-            # host core at ~1k videos/s — hostprof, round 5)
-            out = np.empty(self._batch_shape(bucket), dtype=np.uint8)
-        cards, row = [], 0
-        with hostprof.section("loader.emit_wait+copy"):
-            for rec in ok:
-                n = rec.handle.n
-                out[row:row + n] = rec.handle.out[:n]
-                row += n
-                cards.extend(rec.cards)
-            if row < out.shape[0]:
-                out[row:] = 0
+        out, slot = self._assemble(ok, rows, bucket)
         if self.cache is not None:
             # insert-after-success: only decodes that reached this
-            # point populate the cache. The fused batch crosses the
-            # wire as one array, so each entry pays its own (first and
-            # only) transfer here — hits amortize it away.
+            # point populate the cache. insert_host copies the rows
+            # out of the slot BEFORE the transfer/recycle below, so a
+            # cached entry can never alias recycled staging memory.
             with hostprof.section("loader.cache_insert"):
                 for rec in ok:
                     if rec.key is not None:
@@ -842,12 +1101,143 @@ class R2P1DFusingLoader(R2P1DLoader):
                         self.cache.insert_host(
                             rec.key, rec.handle.out, n,
                             self._batch_shape(self._bucket_for(n)))
+        cards = []
+        for rec in ok:
+            cards.extend(rec.cards)
+        if slot is not None:
+            # the taken rows are consumed once the transfer below
+            # confirms; the begin/finish_transfer hold keeps the slot
+            # unreusable until then, so the refs can retire now
+            self.staging.begin_transfer(slot)
+            for rec in ok:
+                self._release_handle_slot(rec.handle)
+        if self._worker is not None:
+            # pipelined handoff: the worker transfers batch N while
+            # this thread plans/harvests batch N+1
+            self._worker.submit(
+                lambda: self._transfer_job(out, slot, rows, cards))
+            return True
+        self._transfer_sync(out, slot, rows, cards)
+        return True
+
+    def _min_live_row(self, slot) -> int:
+        """Lowest row of a not-yet-taken decode planned into ``slot``
+        (records still in the ready/in-flight windows); the slot's row
+        capacity when none. Bounds how far an emission may read/zero
+        the slot without racing a live decode."""
+        lo = slot.buf.shape[0]
+        for rec in self._ready:
+            h = rec.handle
+            if h.slot is slot and h.row0 < lo:
+                lo = h.row0
+        for rec in self._inflight:
+            h = rec.handle
+            if h.slot is slot and h.row0 < lo:
+                lo = h.row0
+        return lo
+
+    def _assemble(self, ok, rows: int, bucket: int):
+        """The fused batch bytes for one emission: ``(array, slot)``.
+        A non-None slot means zero-copy — the array is the slot's
+        C-contiguous bucket prefix, assembled by the decoder itself.
+        None means the copy fallback ran: non-native decodes, re-decoded
+        retries, partial-slot takes (hold-timeout leftovers), a
+        contained failure's row gap, or staging disabled."""
+        slot = ok[0].handle.slot
+        if slot is not None and ok[0].handle.row0 == 0 \
+                and bucket <= slot.buf.shape[0]:
+            staged, row = True, 0
+            for rec in ok:
+                h = rec.handle
+                if h.slot is not slot or h.row0 != row:
+                    staged = False  # gap: failure/retry/partial history
+                    break
+                row += h.n
+            if staged and bucket > self._min_live_row(slot):
+                # the transfer window would cover rows a live decode
+                # is still writing — only possible after a partial
+                # (hold-timeout) take left batchmates in flight
+                staged = False
+            if staged:
+                if bucket > rows:
+                    with hostprof.section("loader.emit_copy"):
+                        # seed byte parity: padding rows stay zeroed
+                        slot.buf[rows:bucket] = 0
+                self.staging.note_staged()
+                return slot.buf[:bucket], slot
+        with hostprof.section("loader.emit_alloc"):
+            # copy fallback (RNB-H007 baselined): rows [0, rows) are
+            # overwritten below; only the padding tail needs zeroing
+            out = np.empty(self._batch_shape(bucket), dtype=np.uint8)
+        row = 0
+        with hostprof.section("loader.emit_copy"):
+            for rec in ok:
+                n = rec.handle.n
+                out[row:row + n] = rec.handle.out[:n]
+                row += n
+            if row < out.shape[0]:
+                out[row:] = 0
+        for rec in ok:
+            # rows copied out: slot references retire immediately
+            self._release_handle_slot(rec.handle)
+        if self.staging is not None:
+            self.staging.note_copied()
+        return out, None
+
+    def _transfer_sync(self, out, slot, rows: int, cards) -> None:
+        """Inline transfer on the executor thread (transfer_async
+        off): the seed path minus the assembly — the transfer is
+        confirmed lazily at the slot's next acquire, so the executor
+        still never blocks on transfer completion."""
+        jax, _ = _jax_numpy()
         with hostprof.section("loader.device_put"):
             batch = jax.device_put(out, self._jax_device)
+        if slot is not None:
+            self.staging.finish_transfer(slot, batch)
         if self._preprocess is not None:
             with hostprof.section("loader.preprocess_dispatch"):
                 batch = self._preprocess(batch)
-        return ((PaddedBatch(batch, row),), None, TimeCardList(cards))
+        self._push_ready(((PaddedBatch(batch, rows),), None,
+                          TimeCardList(cards)))
+
+    def _transfer_job(self, out, slot, rows: int, cards) -> None:
+        """Transfer-worker body: issue the device_put for batch N
+        while the executor decodes batch N+1 into the next slot;
+        confirm completion (alias-probed) before releasing the slot's
+        transfer hold. Runs off the executor thread."""
+        jax, _ = _jax_numpy()
+        with hostprof.section("transfer.device_put"):
+            batch = jax.device_put(out, self._jax_device)
+        if slot is not None:
+            with hostprof.section("transfer.confirm"):
+                self.staging.confirm_now(slot, batch)
+        if self._preprocess is not None:
+            with hostprof.section("transfer.preprocess_dispatch"):
+                batch = self._preprocess(batch)
+        self._push_ready(((PaddedBatch(batch, rows),), None,
+                          TimeCardList(cards)))
+
+    def _push_ready(self, emission) -> None:
+        with self._out_lock:
+            self._out_ready.append(emission)
+
+    def _pop_ready(self):
+        with self._out_lock:
+            if self._out_ready:
+                return self._out_ready.popleft()
+        return None
+
+    def take_ready(self):
+        """Executor protocol (rnb_tpu.runner): a completed fused
+        emission ready to publish, or None. Drained at the top of the
+        hot loop so finished transfers publish ahead of new input.
+        Re-raises transfer-pipeline failures on the executor thread —
+        a dead worker must abort the job, not hang it."""
+        if self._worker is not None:
+            self._worker.raise_if_failed()
+        if self.staging is not None:
+            self.staging.raise_if_failed()
+        return self._pop_ready()
 
     def _emit_hit(self, entry, time_card):
         """A cache hit emits immediately as its own dispatch: there is
@@ -868,6 +1258,9 @@ class R2P1DFusingLoader(R2P1DLoader):
         instead of on the next 50 ms poll tick — the round-5 frontier
         measured that granularity as the light-load p99 floor
         (57-61 ms at 111 req/s vs the 5-8 ms configured hold)."""
+        with self._out_lock:
+            if self._out_ready:
+                return 0.0  # a completed emission awaits publishing
         self._harvest()  # peek-only: fresh view of completed decodes
         if self._ready:
             if not self._inflight:
@@ -880,6 +1273,8 @@ class R2P1DFusingLoader(R2P1DLoader):
             return min(remaining, self.HARVEST_TICK_S)
         if self._inflight:
             return self.HARVEST_TICK_S
+        if self._worker is not None and self._worker.outstanding():
+            return self.HARVEST_TICK_S  # a transfer is still in flight
         return None
 
     def poll(self):
@@ -888,7 +1283,12 @@ class R2P1DFusingLoader(R2P1DLoader):
         — most importantly the hold-timeout, which otherwise could
         only fire on the NEXT arrival and would pay a full
         inter-arrival gap instead of max_hold_ms (+ the executor's
-        poll granularity). Returns an emission or None."""
+        poll granularity). Returns an emission or None (an emission
+        handed to the transfer worker surfaces on a later poll /
+        take_ready once its transfer completes)."""
+        out = self._pop_ready()
+        if out is not None:
+            return out
         self._harvest()
         if not self._ready:
             return None
@@ -898,7 +1298,8 @@ class R2P1DFusingLoader(R2P1DLoader):
                 or not self._inflight
                 or (time.monotonic() - self._ready[0].t_ready) * 1000.0
                 > self.max_hold_ms):
-            return self._emit()
+            self._emit()
+            return self._pop_ready()
         return None
 
     def __call__(self, tensors, non_tensors, time_card):
@@ -940,37 +1341,76 @@ class R2P1DFusingLoader(R2P1DLoader):
                 rec.t_ready = time.monotonic()
                 self._ready.append(rec)
             self._harvest()
-            out = self._emit()
+            self._emit()
+            out = self._pop_ready()
             if out is not None:
                 return out
         return None, None, None
 
+    #: ready-queue poll tick while waiting on the transfer worker at
+    #: end-of-stream — bounded by one transfer's latency
+    FLUSH_TICK_S = 0.0005
+
     def flush(self):
         """End-of-stream: drain everything, one fused batch per call
-        (the executor calls flush() until it returns None)."""
+        (the executor calls flush() until it returns None). Under
+        ``transfer_async`` this also drains the transfer worker —
+        emissions it still holds surface here before the stage
+        reports itself dry."""
+        out = self._pop_ready()
+        if out is not None:
+            return out
         while self._inflight:
             rec = self._inflight.popleft()
             if self._wait_contained(rec):
                 rec.t_ready = time.monotonic()
                 self._ready.append(rec)
-        while self._ready:
-            out = self._emit()
-            if out is not None:
-                return out
-            # that whole batch failed — its cards are on the
-            # take_failed() queue; keep draining the rest
-        return None
+        while True:
+            if self._ready:
+                self._emit()
+                out = self._pop_ready()
+                if out is not None:
+                    return out
+                # that whole batch failed (cards on the take_failed()
+                # queue) or it was handed to the transfer worker —
+                # keep draining either way
+                continue
+            if self._worker is not None and self._worker.outstanding():
+                self._worker.raise_if_failed()
+                time.sleep(self.FLUSH_TICK_S)
+                out = self._pop_ready()
+                if out is not None:
+                    return out
+                continue
+            if self._worker is not None:
+                # a failing last job can drop outstanding() to 0 with
+                # its error recorded but not yet observed — re-check
+                # before reporting a clean drain, or the runner would
+                # break out silently with the batch's requests lost
+                self._worker.raise_if_failed()
+            if self.staging is not None:
+                self.staging.raise_if_failed()
+            return None
 
     def discard_pending(self) -> None:
         """Abort path (called from the executor's finally): retire
         every submitted decode so native tickets don't pin buffers
-        forever. Ready-but-unemitted handles hold un-retired tickets
+        forever — and every staging-slot reference, then stop the
+        transfer worker (draining its queue keeps the slot accounting
+        balanced). Ready-but-unemitted handles hold un-retired tickets
         too — harvest only peeks, it never waits."""
         for rec in list(self._inflight) + list(self._ready):
             self._drop_coalesce(rec)
             self.discard(rec.handle, rec.video)
         self._inflight.clear()
         self._ready.clear()
+        self._open_slot = None
+        if self._worker is not None:
+            self._worker.close()
+        with self._out_lock:
+            # abort path: completed-but-unpublished emissions are
+            # dropped, exactly like ready-but-unemitted records
+            self._out_ready.clear()
 
 
 class R2P1DRunner(StageModel):
@@ -1142,9 +1582,11 @@ class R2P1DSingleStep(StageModel):
         self.loader = R2P1DLoader(device, max_clips=max_clips,
                                   consecutive_frames=consecutive_frames,
                                   num_warmups=num_warmups, **kwargs)
-        # surface the embedded loader's clip cache (if configured) so
-        # the executor's cache-stats sink sees it (rnb_tpu.runner)
+        # surface the embedded loader's clip cache (if configured) and
+        # staging pool so the executor's stats sinks see them
+        # (rnb_tpu.runner)
         self.cache = self.loader.cache
+        self.staging = self.loader.staging
         # the inner runner must warm the same bucket shapes the loader
         # emits, or the first occurrence of each bucket would pay a
         # silent XLA recompile inside the measured window

@@ -136,6 +136,10 @@ class RunnerContext:
     #: controller can report job-wide hit/miss/eviction/coalesced
     #: counts (BenchmarkResult + log-meta `Cache:` line)
     cache_sink: Optional[List] = None
+    #: stages owning a staging pool (rnb_tpu.staging: zero-copy decode
+    #: staging on a loader step) append their final pool snapshot here
+    #: (BenchmarkResult + log-meta `Staging:` line)
+    staging_sink: Optional[List] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -324,6 +328,10 @@ def runner(ctx: RunnerContext) -> None:
     # request failures through take_failed(); resolve once
     take_failed = getattr(model, "take_failed", None)
     take_retries = getattr(model, "take_retries", None)
+    # stages with a pipelined transfer handoff (rnb_tpu.staging:
+    # transfer_async on a fusing loader) surface completed emissions
+    # through take_ready(); resolve once
+    take_ready = getattr(model, "take_ready", None)
     if model is not None and take_failed is not None and ctx.containment:
         # stages with internal containment retry transients themselves;
         # hand them the step's schema retry knobs (never model kwargs).
@@ -366,7 +374,16 @@ def runner(ctx: RunnerContext) -> None:
                 # final ``num_videos mod batch`` requests complete
                 # instead of stranding the run
                 flushed = None
-                if saw_marker and prefetch_depth == 0:
+                if take_ready is not None:
+                    # publish handoff: a fused batch whose (possibly
+                    # worker-side) transfer completed publishes BEFORE
+                    # new input is admitted — bounded completion
+                    # latency, and natural backpressure toward the
+                    # input queue while transfers are behind
+                    flushed = take_ready()
+                if flushed is not None:
+                    pass  # fall through to the publish path below
+                elif saw_marker and prefetch_depth == 0:
                     # draining: the stage may hold MORE than one pending
                     # batch (e.g. a fusing loader's accumulator), so
                     # keep calling flush() until it runs dry instead of
@@ -725,6 +742,15 @@ def runner(ctx: RunnerContext) -> None:
                 and getattr(model, "cache", None) is not None):
             try:
                 ctx.cache_sink.append(model.cache.snapshot())
+            except Exception:
+                traceback.print_exc()
+        # staging-owning stages likewise report their final pool
+        # counters (discard_pending above already stopped any transfer
+        # worker, so the snapshot is stable)
+        if (ctx.staging_sink is not None
+                and getattr(model, "staging", None) is not None):
+            try:
+                ctx.staging_sink.append(model.staging.snapshot())
             except Exception:
                 traceback.print_exc()
         try:

@@ -115,6 +115,9 @@ META_LINE_REGISTRY = (
               "JSON per-site shed counts"),
     StampSpec("Cache:", "rnb_tpu/benchmark.py",
               "clip-cache counters (cache-enabled runs only)"),
+    StampSpec("Staging:", "rnb_tpu/benchmark.py",
+              "zero-copy decode-staging pool counters "
+              "(staging-enabled runs only)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry

@@ -9,8 +9,9 @@ scheme; see SURVEY.md §2.1 #15):
 * ``logs/<job_id>/log-meta.txt`` — written by rnb_tpu/benchmark.py: an
   ``Args: Namespace(...)`` repr, start/end wall-clock timestamps, the
   termination flag, a ``Faults: num_failed=K num_shed=S num_retries=R``
-  accounting line, and (when any request failed) a ``Failure reasons:``
-  JSON line with per-reason counts.
+  accounting line, (when any request failed) a ``Failure reasons:``
+  JSON line with per-reason counts, and — on cache-/staging-enabled
+  runs only — the ``Cache:`` and ``Staging:`` counter lines.
 * ``logs/<job_id>/<device>-group<g>-<i>.txt`` — one whitespace table
   per final-step instance (rnb_tpu/telemetry.py TimeCardSummary
   .save_full_report): a header of event keys followed by per-step
@@ -63,6 +64,14 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["cache_" + key] = int(val)
+        elif line.startswith("Staging:"):
+            # "Staging: slots=S slot_bytes=B acquires=A
+            #  acquire_waits=W staged_batches=Z copied_batches=C
+            #  reallocs=R" — written only by runs whose loader built a
+            # zero-copy staging pool (rnb_tpu.staging)
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["staging_" + key] = int(val)
         elif line.startswith("Failure reasons:"):
             import json
             meta["failure_reasons"] = json.loads(line.split(":", 1)[1])
@@ -375,6 +384,31 @@ def check_job(job_dir: str) -> List[str]:
                                meta["cache_misses"]))
         if meta.get("cache_bytes_resident", 0) < 0:
             problems.append("negative cache_bytes_resident")
+
+    # staging accounting (rnb_tpu.staging): a wait happens inside an
+    # acquire, and an alias-forced realloc happens at most once per
+    # confirmed staged transfer — violations mean counter drift
+    if "staging_acquires" in meta:
+        for key in ("staging_slots", "staging_slot_bytes",
+                    "staging_acquires", "staging_acquire_waits",
+                    "staging_staged_batches", "staging_copied_batches",
+                    "staging_reallocs"):
+            if meta.get(key, 0) < 0:
+                problems.append("negative %s" % key)
+        if meta.get("staging_acquire_waits", 0) \
+                > meta.get("staging_acquires", 0):
+            problems.append(
+                "staging_acquire_waits=%d exceeds staging_acquires=%d "
+                "(every wait is part of an acquire)"
+                % (meta["staging_acquire_waits"],
+                   meta["staging_acquires"]))
+        if meta.get("staging_reallocs", 0) \
+                > meta.get("staging_staged_batches", 0):
+            problems.append(
+                "staging_reallocs=%d exceeds staging_staged_batches=%d "
+                "(a realloc needs a confirmed staged transfer)"
+                % (meta["staging_reallocs"],
+                   meta["staging_staged_batches"]))
     return problems
 
 

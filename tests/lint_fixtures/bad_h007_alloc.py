@@ -1,0 +1,13 @@
+"""RNB-H007: bucket-shaped host allocation per emission."""
+
+import numpy as np
+
+
+class Stage:
+    def _batch_shape(self, rows):
+        return (rows, 8, 112, 112, 3)
+
+    def __call__(self, tensors, non_tensors, time_card):
+        out = np.empty(self._batch_shape(4), dtype=np.uint8)
+        out[:] = 0
+        return (out,), non_tensors, time_card

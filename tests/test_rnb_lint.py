@@ -73,6 +73,7 @@ HOTPATH_CASES = [
     ("bad_h004_random.py", "RNB-H004"),
     ("bad_h005_shed.py", "RNB-H005"),
     ("bad_h006_sync.py", "RNB-H006"),
+    ("bad_h007_alloc.py", "RNB-H007"),
 ]
 
 
@@ -144,6 +145,7 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Failure reasons: %s\\n" % r)\n'
                      'f.write("Shed sites: %s\\n" % s)\n'
                      'f.write("Cache: hits=%d\\n" % h)\n'
+                     'f.write("Staging: slots=%d\\n" % s)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -170,7 +172,10 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'f.write("Faults: num_failed=%d num_shed=%d num_retries=%d '
         'num_bogus=%d\\n" % x)\n'
         'f.write("Cache: hits=%d misses=%d inserts=%d evictions=%d '
-        'coalesced=%d oversize=%d bytes_resident=%d\\n" % y)\n')
+        'coalesced=%d oversize=%d bytes_resident=%d\\n" % y)\n'
+        'f.write("Staging: slots=%d slot_bytes=%d acquires=%d '
+        'acquire_waits=%d staged_batches=%d copied_batches=%d '
+        'reallocs=%d\\n" % z)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}
