@@ -32,7 +32,12 @@ Rules
   emit is not covered by the consumer's warmed bucket set — every
   uncovered bucket is a silent XLA recompile inside the measured
   window. Consumers with ``REPACKS_ROWS`` (Batcher) accept any
-  upstream buckets and are skipped.
+  upstream buckets and are skipped. Also covers the ``autotune`` root
+  key: an ``autotune.buckets`` restriction naming a row bucket some
+  participating stage (``SUPPORTS_AUTOTUNE``, not opted out via the
+  step's ``"autotune": false``) never warms — the controller refuses
+  it at launch precisely because a chosen un-warmed bucket would be a
+  mid-run recompile, and this rule rejects it statically.
 * ``RNB-G007`` invalid-cache-mb: a ``cache_mb`` value the stage would
   reject at construction (non-numeric or negative; 0 disables).
 * ``RNB-G008`` dtype-mismatch: producer output dtype and consumer
@@ -191,6 +196,44 @@ def check_config(path: str, root: str = ".") -> List[Finding]:
                         "config key %r is not a constructor parameter "
                         "of %s — the open kwargs passthrough would "
                         "silently drop it" % (key, cls.__name__)))
+
+    # load-adaptive batching (root 'autotune' key, rnb_tpu.autotune):
+    # an autotune.buckets restriction must stay inside each
+    # participating stage's warmed bucket set — the same invariant
+    # BatchController.for_stage enforces at launch, checked statically
+    autotune = config.autotune
+    if autotune is not None and autotune.get("enabled", True) \
+            and autotune.get("buckets"):
+        restricted = set(int(b) for b in autotune["buckets"])
+        for step_idx, (step, cls) in enumerate(zip(config.steps,
+                                                   classes)):
+            if cls is None or not step.autotune \
+                    or not getattr(cls, "SUPPORTS_AUTOTUNE", False):
+                continue
+            for group_idx, group in enumerate(step.groups):
+                anchor = "step%d.group%d.autotune" % (step_idx,
+                                                      group_idx)
+                kwargs = step.kwargs_for_group(group_idx)
+                shapes = _declared(cls, "output_shape_for", kwargs,
+                                   rel, anchor, findings)
+                if not shapes:
+                    continue
+                warmed = _emission_rows(
+                    tuple(map(tuple, shapes)),
+                    kwargs.get("row_buckets"), rel, anchor, findings)
+                if warmed is None:
+                    continue
+                missing = sorted(restricted - warmed)
+                if missing:
+                    findings.append(Finding(
+                        "RNB-G006", rel, 0, anchor,
+                        "'autotune.buckets' %s name row bucket(s) %s "
+                        "that %s never warms (warmed: %s) — an "
+                        "autotune decision for one would be a silent "
+                        "mid-run recompile, so the controller rejects "
+                        "this config at launch"
+                        % (sorted(restricted), missing, cls.__name__,
+                           sorted(warmed))))
 
     # step-to-step metadata propagation along the queue wiring
     for step_idx in range(1, config.num_steps):

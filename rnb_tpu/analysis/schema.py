@@ -25,9 +25,10 @@ Rules
 * ``RNB-T005`` unparsed-meta-or-trailer: a registered meta-line prefix
   or trailer kind ``parse_utils`` never checks for.
 * ``RNB-T006`` result-field-drift: a ``key=value`` counter written to
-  the Faults:/Cache:/Staging: log-meta lines with no matching
-  ``BenchmarkResult`` field (or vice versa for the cache/fault/staging
-  field families).
+  the Faults:/Cache:/Staging:/Autotune: log-meta lines with no
+  matching ``BenchmarkResult`` field (or vice versa for the
+  cache/fault/staging/autotune field families; dict-valued fields
+  ride their own JSON meta lines and are exempt).
 * ``RNB-T007`` unregistered-content-stamp: an attribute stamped onto a
   TimeCard (``time_card.x = ...``) that is neither a core TimeCard
   attribute nor declared in ``CONTENT_STAMPS`` — it would silently
@@ -201,7 +202,8 @@ def extract_trailer_kinds(telemetry_path: str, root: str = "."
 #: prefix their ``key=value`` tokens map to (the same mapping
 #: parse_utils applies when flattening the meta dict)
 COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
-                         "Staging:": "staging_"}
+                         "Staging:": "staging_",
+                         "Autotune:": "autotune_"}
 
 
 def extract_meta_counter_keys(benchmark_path: str) -> Dict[str, Set[str]]:
@@ -350,13 +352,20 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
                     "%s line writes %r but BenchmarkResult has no %r "
                     "field — programmatic callers cannot see the "
                     "counter the log records" % (prefix, key, field)))
-    # reverse direction for the same two counter families: a result
-    # field nothing writes to the meta line is invisible to offline
-    # parsing (parse_utils reads log-meta, not BenchmarkResult)
-    for field in sorted(fields):
+    # reverse direction for the same counter families: a result field
+    # nothing writes to the meta line is invisible to offline parsing
+    # (parse_utils reads log-meta, not BenchmarkResult). Dict-valued
+    # fields (bucket counts, per-edge overflows) ride their own JSON
+    # meta lines, not key=value counters, so they are exempt here —
+    # recognized by their shared default_factory, not by spelling of
+    # the annotation (which `dict[...]`/`Mapping[...]` would break).
+    dict_fields = {f.name for f in dataclasses.fields(BenchmarkResult)
+                   if f.default_factory is dict}
+    for field in sorted(fields - dict_fields):
         if field in ("num_failed", "num_shed", "num_retries") \
                 or field.startswith("cache_") \
-                or field.startswith("staging_"):
+                or field.startswith("staging_") \
+                or field.startswith("autotune_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,

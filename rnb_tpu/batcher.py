@@ -14,8 +14,11 @@ request's card.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
+from rnb_tpu.autotune import BatchController
 from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
 from rnb_tpu.telemetry import TimeCardList
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
@@ -40,6 +43,14 @@ class Batcher(StageModel):
     # valid rows and re-pads to its OWN bucket set / max shape
     REPACKS_ROWS = True
 
+    #: the accumulate/emit decision and the pad bucket can be driven
+    #: by the load-adaptive controller (rnb_tpu.autotune): under
+    #: autotune the static `batch` count becomes a ceiling and the
+    #: controller emits as soon as growing the window cannot meet the
+    #: latency budget — with a hold deadline, which the static batcher
+    #: never had (it waited for `batch` arrivals or end-of-stream)
+    SUPPORTS_AUTOTUNE = True
+
     def __init__(self, device, batch=1, shapes=None, max_rows=MAX_ROWS,
                  consecutive_frames=8, frame_hw=112, row_buckets=None,
                  **kwargs):
@@ -60,6 +71,23 @@ class Batcher(StageModel):
             if row_buckets else None)
         self._tensors = []      # list of tuples of PaddedBatch
         self._time_cards = []
+        #: load-adaptive batching controller (rnb_tpu.autotune), set
+        #: by the executor via enable_autotune(); None = static
+        #: accumulate-to-`batch` semantics exactly as configured
+        self.autotune = None
+        #: monotonic instant the oldest pending request joined the
+        #: accumulator (None when empty) — the hold-deadline anchor
+        self._t_oldest = None
+
+    def enable_autotune(self, settings) -> BatchController:
+        """Executor protocol (rnb_tpu.runner): drive this stage's
+        accumulate/emit decision and pad bucket with a BatchController
+        over the stage's own warmed bucket set — decisions can only
+        name shapes the downstream stage warmed."""
+        self.autotune = BatchController.for_stage(
+            settings, self.row_buckets or (self._declared_max[0],),
+            self._declared_max[0])
+        return self.autotune
 
     def input_shape(self):
         # the batcher re-packs whatever it receives, so its input max
@@ -120,13 +148,80 @@ class Batcher(StageModel):
 
         self._tensors.append(tensors)
         self._time_cards.append(time_card)
+        if self._t_oldest is None:
+            self._t_oldest = time.monotonic()
+        if self.autotune is not None:
+            # rows per CLIENT request, not per upstream emission: a
+            # fused upstream delivers many requests' rows in one call,
+            # and the runner feeds the inter-arrival EWMA per
+            # constituent card — mixing per-emission rows with
+            # per-request gaps would understate residual-fill time by
+            # the upstream fuse factor and hold when growth cannot
+            # meet the budget
+            n_req = len(getattr(time_card, "time_cards", None) or (1,))
+            self.autotune.observe_rows(tensors[0].valid / n_req)
         if early is not None:
             return early
-        if len(self._time_cards) < self.batch:
-            return None, None, None
-        return self._emit_fused()
+        if len(self._time_cards) >= self.batch:
+            # the static fuse count stays a hard ceiling under autotune
+            return self._emit_fused()
+        if self.autotune is not None:
+            # controller-driven early emission: dispatch now when
+            # growing the window cannot meet the latency budget (the
+            # static batcher would wait for `batch` arrivals — at low
+            # rate that wait is unbounded until end-of-stream)
+            rows, waited, dec = self._decide()
+            if rows >= dec.target_rows or waited >= dec.hold_s:
+                return self._emit_fused()
+        return None, None, None
+
+    def _decide(self, peek=False):
+        """``(rows_ready, oldest_wait_s, Decision)`` for the current
+        accumulator state — the single place the controller's inputs
+        are derived, so the emit check (__call__/poll) and the
+        deadline the executor polls on (next_deadline_s) can never
+        diverge. ``peek`` skips the controller's decision accounting
+        (deadline queries happen every executor poll tick)."""
+        rows = sum(parts[0].valid for parts in self._tensors)
+        waited = time.monotonic() - self._t_oldest
+        ask = self.autotune.peek if peek else self.autotune.decide
+        return rows, waited, ask(len(self._time_cards), rows, waited)
+
+    def next_deadline_s(self):
+        """Seconds until the controller's hold deadline for the oldest
+        pending request, or None when nothing is held (or autotune is
+        off — the static batcher has no deadline: it waits for
+        arrivals). The executor shrinks its queue-poll timeout to
+        this (rnb_tpu.runner.poll_plan)."""
+        if self.autotune is None or self._t_oldest is None:
+            return None
+        _, waited, dec = self._decide(peek=True)
+        return max(0.0, dec.hold_s - waited)
+
+    def poll(self):
+        """Idle tick from the executor (no arrival within its queue
+        poll window): emit the held partial batch once its controller
+        hold deadline expired. Without this, a held batch could only
+        emit on the NEXT arrival — exactly the unbounded low-rate wait
+        autotune exists to remove. Static mode (autotune off) keeps
+        the accumulate-to-`batch` semantics: always None."""
+        if self.autotune is None or self._t_oldest is None:
+            return None
+        rows, waited, dec = self._decide()
+        if rows >= dec.target_rows or waited >= dec.hold_s:
+            return self._emit_fused()
+        return None
 
     def _bucket_for(self, rows: int, max_rows: int) -> int:
+        if self.autotune is not None:
+            # restrict the pad bucket to the controller's candidate
+            # set (warmed buckets, optionally narrowed by
+            # autotune.buckets) so emissions land on the shapes the
+            # decisions reason about; rows exceeding every candidate
+            # fall back to the static rule (never pad short)
+            bucket = self.autotune.bucket_for(rows)
+            if rows <= bucket <= max_rows:
+                return bucket
         if self.row_buckets:
             for bucket in self.row_buckets:
                 if rows <= bucket <= max_rows:
@@ -138,11 +233,14 @@ class Batcher(StageModel):
         for pos, parts in enumerate(zip(*self._tensors)):
             valid = sum(pb.valid for pb in parts)
             bucket = self._bucket_for(valid, self._declared_max[pos])
+            if pos == 0 and self.autotune is not None:
+                self.autotune.note_emission(bucket)
             fused.append(self._fuse_parts(parts, valid, bucket))
 
         cards = TimeCardList(self._time_cards)
         self._tensors = []
         self._time_cards = []
+        self._t_oldest = None
         # Per-request metadata cannot be attributed to a fused batch; emit
         # None rather than one arbitrary constituent's non_tensors
         # (reference batcher.py:34 does the same).

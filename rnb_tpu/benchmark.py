@@ -112,6 +112,27 @@ class BenchmarkResult:
     staging_staged_batches: int = 0
     staging_copied_batches: int = 0
     staging_reallocs: int = 0
+    #: load-adaptive batching accounting (rnb_tpu.autotune), summed
+    #: over every controller-owning stage instance; all zero when the
+    #: config carries no enabled `autotune` root key. decisions =
+    #: controller consultations (every emission is covered by one, so
+    #: decisions >= emissions); immediate/held split them by verdict;
+    #: the deadline_us_* triple summarizes the held-decision deadline
+    #: histogram (min/max/sum microseconds).
+    autotune_decisions: int = 0
+    autotune_immediate: int = 0
+    autotune_held: int = 0
+    autotune_emissions: int = 0
+    autotune_deadline_us_min: int = 0
+    autotune_deadline_us_max: int = 0
+    autotune_deadline_us_sum: int = 0
+    #: emissions per chosen row bucket (keys are stringified row
+    #: counts; always a subset of the configured warmed buckets)
+    autotune_bucket_counts: Dict[str, int] = field(default_factory=dict)
+    #: per-edge queue-overflow counts under the "abort" overload
+    #: policy (rnb_tpu.control.FaultStats.record_overflow) — the
+    #: events that used to be an unparseable stdout warning
+    queue_overflows: Dict[str, int] = field(default_factory=dict)
 
 
 def run_benchmark(config_path: str,
@@ -162,7 +183,35 @@ def run_benchmark(config_path: str,
     summary_sink: list = []
     cache_sink: list = []
     staging_sink: list = []
+    autotune_sink: list = []
     fault_stats = FaultStats()
+    # load-adaptive batching (rnb_tpu.autotune): one validated settings
+    # object shared by every participating stage; per-step opt-out via
+    # "autotune": false on the step
+    from rnb_tpu.autotune import AutotuneSettings
+    autotune_settings = AutotuneSettings.from_config(config.autotune)
+    if autotune_settings is not None:
+        # enabled-but-inert is a measurement confound: an operator
+        # A/B-ing against a static baseline must be able to tell a
+        # pipeline where no stage participates (every step opted out,
+        # or none SUPPORTS_AUTOTUNE) from an adaptive run. Class-load
+        # failures are deferred to the runner thread, which owns that
+        # error path.
+        from rnb_tpu.utils.class_utils import load_class
+
+        def _may_participate(step):
+            try:
+                return getattr(load_class(step.model),
+                               "SUPPORTS_AUTOTUNE", False)
+            except Exception:
+                return True
+        if not any(step.autotune and _may_participate(step)
+                   for step in config.steps):
+            print("[rnb-tpu] WARNING: autotune is enabled but no "
+                  "pipeline stage participates (every step opted out "
+                  "or unsupported) — batching stays static and no "
+                  "Autotune: telemetry will be emitted",
+                  file=sys.stderr)
     fault_plan = FaultPlan.resolve(config.fault_plan)
     if fault_plan is not None:
         # env-provided plans bypass config parsing — re-check their
@@ -253,6 +302,9 @@ def run_benchmark(config_path: str,
                     fault_stats=fault_stats,
                     cache_sink=cache_sink,
                     staging_sink=staging_sink,
+                    autotune=(autotune_settings if step.autotune
+                              else None),
+                    autotune_sink=autotune_sink,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -375,6 +427,11 @@ def run_benchmark(config_path: str,
         from rnb_tpu.staging import aggregate_snapshots as \
             aggregate_staging
         staging_stats = aggregate_staging(staging_sink)
+    autotune_stats = None
+    if autotune_sink:
+        from rnb_tpu.autotune import aggregate_snapshots as \
+            aggregate_autotune
+        autotune_stats = aggregate_autotune(autotune_sink)
 
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
@@ -401,6 +458,12 @@ def run_benchmark(config_path: str,
         if faults["shed_sites"]:
             f.write("Shed sites: %s\n"
                     % json.dumps(faults["shed_sites"], sort_keys=True))
+        if faults["overflow_sites"]:
+            # abort-policy full-queue events, counted per edge — the
+            # parseable replacement for the old stdout warning
+            f.write("Queue overflows: %s\n"
+                    % json.dumps(faults["overflow_sites"],
+                                 sort_keys=True))
         if cache_stats is not None:
             # only cache-enabled runs carry the line, keeping cacheless
             # logs byte-stable with the pre-cache schema
@@ -423,6 +486,23 @@ def run_benchmark(config_path: str,
                        staging_stats["staged_batches"],
                        staging_stats["copied_batches"],
                        staging_stats["reallocs"]))
+        if autotune_stats is not None:
+            # only autotune-enabled runs carry the lines, keeping
+            # static-batching logs byte-stable with the earlier schema
+            f.write("Autotune: decisions=%d immediate=%d held=%d "
+                    "emissions=%d deadline_us_min=%d "
+                    "deadline_us_max=%d deadline_us_sum=%d\n"
+                    % (autotune_stats["decisions"],
+                       autotune_stats["immediate"],
+                       autotune_stats["held"],
+                       autotune_stats["emissions"],
+                       autotune_stats["deadline_us_min"],
+                       autotune_stats["deadline_us_max"],
+                       autotune_stats["deadline_us_sum"]))
+            if autotune_stats["bucket_counts"]:
+                f.write("Autotune buckets: %s\n"
+                        % json.dumps(autotune_stats["bucket_counts"],
+                                     sort_keys=True))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -473,6 +553,14 @@ def run_benchmark(config_path: str,
                  staging_stats["slot_bytes"] / (1 << 20),
                  staging_stats["acquire_waits"],
                  staging_stats["reallocs"]))
+    if autotune_stats is not None and print_progress:
+        print("Autotune: %d decision(s) (%d immediate / %d held), "
+              "%d emission(s), buckets %s"
+              % (autotune_stats["decisions"],
+                 autotune_stats["immediate"], autotune_stats["held"],
+                 autotune_stats["emissions"],
+                 json.dumps(autotune_stats["bucket_counts"],
+                            sort_keys=True)))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -527,6 +615,22 @@ def run_benchmark(config_path: str,
                                 if staging_stats else 0),
         staging_reallocs=(staging_stats["reallocs"]
                           if staging_stats else 0),
+        autotune_decisions=(autotune_stats["decisions"]
+                            if autotune_stats else 0),
+        autotune_immediate=(autotune_stats["immediate"]
+                            if autotune_stats else 0),
+        autotune_held=autotune_stats["held"] if autotune_stats else 0,
+        autotune_emissions=(autotune_stats["emissions"]
+                            if autotune_stats else 0),
+        autotune_deadline_us_min=(autotune_stats["deadline_us_min"]
+                                  if autotune_stats else 0),
+        autotune_deadline_us_max=(autotune_stats["deadline_us_max"]
+                                  if autotune_stats else 0),
+        autotune_deadline_us_sum=(autotune_stats["deadline_us_sum"]
+                                  if autotune_stats else 0),
+        autotune_bucket_counts=(dict(autotune_stats["bucket_counts"])
+                                if autotune_stats else {}),
+        queue_overflows=dict(faults["overflow_sites"]),
     )
 
 
@@ -595,6 +699,13 @@ def main(argv=None) -> int:
         print("clip cache: %s; popularity: %s"
               % (caches, json.dumps(cfg.popularity, sort_keys=True)
                  if cfg.popularity else "none"))
+        opted_out = [i for i, s in enumerate(cfg.steps)
+                     if not s.autotune]
+        print("autotune: %s%s"
+              % (json.dumps(cfg.autotune, sort_keys=True)
+                 if cfg.autotune else "none",
+                 "; opted-out steps: %s" % opted_out
+                 if opted_out else ""))
         print("rnb_tpu is ready to go!")
         return 0
 

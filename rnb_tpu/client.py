@@ -105,7 +105,13 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
                             dispose_requests(counter, target_num_videos,
                                              termination)
                     else:
-                        print("[WARNING] filename queue is full; aborting")
+                        # counted telemetry (log-meta 'Queue
+                        # overflows:' / BenchmarkResult
+                        # .queue_overflows) instead of a stray
+                        # stdout warning; the termination flag
+                        # still records the abort
+                        if fault_stats is not None:
+                            fault_stats.record_overflow(SHED_SITE)
                         termination.raise_flag(
                             TerminationFlag.FILENAME_QUEUE_FULL)
                         break

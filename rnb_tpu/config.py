@@ -28,18 +28,23 @@ from rnb_tpu.devices import DeviceSpec
 RESERVED_KEYWORDS = [
     "model", "queue_groups", "num_shared_tensors", "num_segments",
     "in_queue", "out_queues", "devices", "gpus", "queue_selector",
-    "async_dispatch", "max_retries", "retry_backoff_ms",
+    "async_dispatch", "max_retries", "retry_backoff_ms", "autotune",
 ]
 
 #: root-level keys with meaning to the runtime (everything else at the
 #: root is rejected to catch typos like "overload_polcy")
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
-    "fault_containment", "fault_plan", "popularity", "_comment",
+    "fault_containment", "fault_plan", "popularity", "autotune",
+    "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
 POPULARITY_KEYWORDS = ["dist", "s", "universe"]
+
+#: keys a root 'autotune' object may carry (rnb_tpu.autotune)
+AUTOTUNE_KEYWORDS = ["enabled", "slo_ms", "ewma_alpha", "min_hold_ms",
+                     "max_hold_ms", "buckets"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -101,6 +106,10 @@ class StepConfig:
     #: failure. Default 0 = fail on first transient.
     max_retries: int = 0
     retry_backoff_ms: float = 10.0
+    #: False opts this step out of the job's load-adaptive batching
+    #: controller (root 'autotune' key, rnb_tpu.autotune); the step
+    #: then keeps its static batching knobs exactly as configured
+    autotune: bool = True
 
     @property
     def effective_shared_tensors(self) -> int:
@@ -135,6 +144,12 @@ class PipelineConfig:
     #: the client wraps the video-path iterator with
     #: rnb_tpu.video_path_provider.ZipfPathIterator when set
     popularity: Optional[Dict[str, Any]] = None
+    #: validated load-adaptive batching spec ({"enabled": ..,
+    #: "slo_ms": .., "ewma_alpha": .., "min_hold_ms": ..,
+    #: "max_hold_ms": .., "buckets": [..]}), or None; the launcher
+    #: builds rnb_tpu.autotune.AutotuneSettings from it and every
+    #: batching stage not opted out gets a BatchController
+    autotune: Optional[Dict[str, Any]] = None
 
     @property
     def num_steps(self) -> int:
@@ -209,6 +224,44 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     and not isinstance(universe, bool) and universe >= 1),
                 "'popularity.universe' must be a positive integer, got %r"
                 % (universe,))
+
+    autotune = raw.get("autotune")
+    if autotune is not None:
+        _expect(isinstance(autotune, dict), "'autotune' must be an object")
+        unknown_at = sorted(set(autotune) - set(AUTOTUNE_KEYWORDS))
+        _expect(not unknown_at,
+                "'autotune' has unknown key(s) %s — keys are %s"
+                % (unknown_at, AUTOTUNE_KEYWORDS))
+        _expect(isinstance(autotune.get("enabled", True), bool),
+                "'autotune.enabled' must be a boolean")
+
+        def _number(key, default, minimum, strict=False):
+            val = autotune.get(key, default)
+            ok = (isinstance(val, (int, float))
+                  and not isinstance(val, bool)
+                  and (val > minimum if strict else val >= minimum))
+            _expect(ok, "'autotune.%s' must be a number %s %g, got %r"
+                    % (key, ">" if strict else ">=", minimum, val))
+            return float(val)
+
+        _number("slo_ms", 50.0, 0, strict=True)
+        alpha = _number("ewma_alpha", 0.2, 0, strict=True)
+        _expect(alpha <= 1.0,
+                "'autotune.ewma_alpha' must be in (0, 1], got %r"
+                % (alpha,))
+        min_hold = _number("min_hold_ms", 0.5, 0)
+        max_hold = _number("max_hold_ms", max(min_hold, 50.0), 0)
+        _expect(max_hold >= min_hold,
+                "'autotune.max_hold_ms' (%g) must be >= "
+                "'autotune.min_hold_ms' (%g)" % (max_hold, min_hold))
+        buckets = autotune.get("buckets")
+        if buckets is not None:
+            _expect(isinstance(buckets, list) and buckets
+                    and all(isinstance(b, int) and not isinstance(b, bool)
+                            and b >= 1 for b in buckets)
+                    and len(set(buckets)) == len(buckets),
+                    "'autotune.buckets' must be a non-empty list of "
+                    "distinct positive row counts, got %r" % (buckets,))
 
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
@@ -369,6 +422,11 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 "%s: 'retry_backoff_ms' must be a non-negative number"
                 % where)
 
+        step_autotune = step_raw.get("autotune", True)
+        _expect(isinstance(step_autotune, bool),
+                "%s: 'autotune' must be a boolean (false opts the step "
+                "out of the root autotune controller)" % where)
+
         step_extras = {k: v for k, v in step_raw.items()
                        if k not in RESERVED_KEYWORDS}
         steps.append(StepConfig(model=step_raw["model"], groups=groups,
@@ -377,11 +435,13 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                                 extras=step_extras,
                                 async_dispatch=async_dispatch,
                                 max_retries=max_retries,
-                                retry_backoff_ms=float(retry_backoff_ms)))
+                                retry_backoff_ms=float(retry_backoff_ms),
+                                autotune=step_autotune))
 
     return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
                           steps=steps, raw=raw,
                           overload_policy=overload_policy,
                           fault_containment=fault_containment,
                           fault_plan=fault_plan,
-                          popularity=popularity)
+                          popularity=popularity,
+                          autotune=autotune)

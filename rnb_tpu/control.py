@@ -90,9 +90,12 @@ class FaultStats:
 
     Counts contained permanent failures (with per-reason totals and a
     bounded dead-letter record of ``(request_id, step_idx, reason)``),
-    shed requests per site, and transient retries. All exact counts;
-    only the dead-letter *detail* list is capped so a pathological run
-    cannot grow controller memory without bound.
+    shed requests per site, transient retries, and per-edge queue
+    overflows (the abort-policy full-queue events that used to be an
+    unparseable stdout warning — now a counter surfaced in
+    BenchmarkResult and the log-meta ``Queue overflows:`` line). All
+    exact counts; only the dead-letter *detail* list is capped so a
+    pathological run cannot grow controller memory without bound.
     """
 
     MAX_DEAD_LETTERS = 1000
@@ -104,6 +107,7 @@ class FaultStats:
         self.num_retries = 0
         self.failure_reasons: Dict[str, int] = {}
         self.shed_sites: Dict[str, int] = {}
+        self.overflow_sites: Dict[str, int] = {}
         self.dead_letters: List[tuple] = []
 
     def record_failure(self, request_ids, step_idx: int,
@@ -127,6 +131,14 @@ class FaultStats:
         with self._lock:
             self.num_retries += n
 
+    def record_overflow(self, edge: str, n: int = 1) -> None:
+        """One inter-stage (or filename) queue hit capacity under the
+        "abort" overload policy — counted per edge so the telemetry
+        names WHERE the pipeline backed up, not just that it died."""
+        with self._lock:
+            self.overflow_sites[edge] = \
+                self.overflow_sites.get(edge, 0) + n
+
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time copy for reports (dead-letter detail included)."""
         with self._lock:
@@ -136,6 +148,7 @@ class FaultStats:
                 "num_retries": self.num_retries,
                 "failure_reasons": dict(self.failure_reasons),
                 "shed_sites": dict(self.shed_sites),
+                "overflow_sites": dict(self.overflow_sites),
                 "dead_letters": list(self.dead_letters),
             }
 

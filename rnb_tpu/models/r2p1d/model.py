@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from rnb_tpu import hostprof
+from rnb_tpu.autotune import BatchController
 from rnb_tpu.cache import content_key
 from rnb_tpu.decode import get_decoder
 from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_RGB,
@@ -848,6 +849,19 @@ class R2P1DFusingLoader(R2P1DLoader):
     #: the transfer worker without breaking any synchronous contract
     SUPPORTS_TRANSFER_ASYNC = True
 
+    #: the emission policy's hold/target/bucket knobs can be driven by
+    #: the load-adaptive controller (rnb_tpu.autotune)
+    SUPPORTS_AUTOTUNE = True
+
+    #: this stage feeds the controller's service-time EWMA itself
+    #: (batch close -> ready-queue span, _pop_ready): under
+    #: transfer_async every emission surfaces via take_ready()/poll(),
+    #: so the executor's stamp-based feed — which skips `flushed`
+    #: emissions — would never observe a sample and the controller
+    #: would price service at 0 forever; the executor must NOT also
+    #: feed this stage from the TimeCard stamps (rnb_tpu.runner)
+    AUTOTUNE_SELF_SERVICE = True
+
     #: default staging depth: one slot filling with planned decodes,
     #: one transferring, one spare so a hold-timeout partial emission
     #: cannot stall planning (double/triple buffering)
@@ -893,6 +907,19 @@ class R2P1DFusingLoader(R2P1DLoader):
         #: step's schema knobs here after construction (the knobs are
         #: schema, not model kwargs, so they never arrive via **kwargs)
         self.fault_retry_budget = (0, 0.0)
+        #: load-adaptive batching controller (rnb_tpu.autotune), set
+        #: by the executor via enable_autotune(); None = the static
+        #: fuse/max_hold_ms emission policy exactly as configured
+        self.autotune = None
+
+    def enable_autotune(self, settings) -> BatchController:
+        """Executor protocol (rnb_tpu.runner): drive this stage's
+        hold deadline / accumulation target with a BatchController
+        over the stage's own warmed bucket set — decisions can only
+        name shapes warm-up already compiled."""
+        self.autotune = BatchController.for_stage(
+            settings, self.row_buckets, self.max_clips)
+        return self.autotune
 
     def _harvest(self) -> None:
         """Move decode-complete requests from in-flight to ready,
@@ -1071,6 +1098,14 @@ class R2P1DFusingLoader(R2P1DLoader):
         # max_clips); a silent min() here would mask clip loss instead
         # of surfacing the broken invariant
         assert rows <= cap, (rows, cap)
+        if hostprof.ENABLED:
+            # batch-hold accounting: how long the oldest taken request
+            # sat ready waiting for batchmates — the fill-wait half of
+            # the latency/throughput trade, split out of emit_wait so
+            # hostprof tables distinguish "holding for a batch" from
+            # "waiting on decode"
+            hostprof.add("loader.hold_wait",
+                         max(0.0, time.monotonic() - take[0].t_ready))
         for rec in take:
             if rec.handle.slot is not None \
                     and rec.handle.slot is self._open_slot:
@@ -1088,6 +1123,20 @@ class R2P1DFusingLoader(R2P1DLoader):
             return True
         rows = sum(rec.handle.n for rec in ok)
         bucket = self._bucket_for(rows)
+        if self.autotune is not None:
+            # every batched emission is attributed to its shipped
+            # bucket; emissions with no preceding decision (forced
+            # drains) are back-filled as immediate decisions so the
+            # --check invariant decisions >= emissions holds
+            self.autotune.note_emission(bucket)
+        # service-span origin for the autotune estimator: the batch
+        # just closed (stopped accumulating); everything from here to
+        # the emission landing on the ready queue — assemble, cache
+        # insert, device_put (inline or on the worker), preprocess
+        # dispatch — is this stage's residual service, the term
+        # decide() budgets against slo_ms alongside the residual-fill
+        # wait
+        t_close = time.monotonic()
         out, slot = self._assemble(ok, rows, bucket)
         if self.cache is not None:
             # insert-after-success: only decodes that reached this
@@ -1115,9 +1164,10 @@ class R2P1DFusingLoader(R2P1DLoader):
             # pipelined handoff: the worker transfers batch N while
             # this thread plans/harvests batch N+1
             self._worker.submit(
-                lambda: self._transfer_job(out, slot, rows, cards))
+                lambda: self._transfer_job(out, slot, rows, cards,
+                                           bucket, t_close))
             return True
-        self._transfer_sync(out, slot, rows, cards)
+        self._transfer_sync(out, slot, rows, cards, bucket, t_close)
         return True
 
     def _min_live_row(self, slot) -> int:
@@ -1184,7 +1234,8 @@ class R2P1DFusingLoader(R2P1DLoader):
             self.staging.note_copied()
         return out, None
 
-    def _transfer_sync(self, out, slot, rows: int, cards) -> None:
+    def _transfer_sync(self, out, slot, rows: int, cards,
+                       bucket: int, t_close: float) -> None:
         """Inline transfer on the executor thread (transfer_async
         off): the seed path minus the assembly — the transfer is
         confirmed lazily at the slot's next acquire, so the executor
@@ -1198,9 +1249,11 @@ class R2P1DFusingLoader(R2P1DLoader):
             with hostprof.section("loader.preprocess_dispatch"):
                 batch = self._preprocess(batch)
         self._push_ready(((PaddedBatch(batch, rows),), None,
-                          TimeCardList(cards)))
+                          TimeCardList(cards)),
+                         bucket, time.monotonic() - t_close)
 
-    def _transfer_job(self, out, slot, rows: int, cards) -> None:
+    def _transfer_job(self, out, slot, rows: int, cards,
+                      bucket: int, t_close: float) -> None:
         """Transfer-worker body: issue the device_put for batch N
         while the executor decodes batch N+1 into the next slot;
         confirm completion (alias-probed) before releasing the slot's
@@ -1215,17 +1268,34 @@ class R2P1DFusingLoader(R2P1DLoader):
             with hostprof.section("transfer.preprocess_dispatch"):
                 batch = self._preprocess(batch)
         self._push_ready(((PaddedBatch(batch, rows),), None,
-                          TimeCardList(cards)))
+                          TimeCardList(cards)),
+                         bucket, time.monotonic() - t_close)
 
-    def _push_ready(self, emission) -> None:
+    def _push_ready(self, emission, bucket=None,
+                    service_s=None) -> None:
+        """Queue a finished emission; ``bucket``/``service_s`` carry
+        the batch-close -> ready service span alongside it. The span
+        is measured where completion happens (possibly the transfer
+        worker thread) but fed to the single-threaded controller only
+        at ``_pop_ready``, on the owning executor thread."""
         with self._out_lock:
-            self._out_ready.append(emission)
+            self._out_ready.append((emission, bucket, service_s))
 
     def _pop_ready(self):
         with self._out_lock:
             if self._out_ready:
-                return self._out_ready.popleft()
-        return None
+                emission, bucket, service_s = self._out_ready.popleft()
+            else:
+                return None
+        if self.autotune is not None and bucket is not None:
+            # self-reported service estimator: under transfer_async
+            # every emission surfaces here (never through a stamp-
+            # bearing __call__ return), so the runner's stamp-based
+            # feed would otherwise starve and service_for() would
+            # stay optimistically 0 — the loader reports its own
+            # close->ready span instead (AUTOTUNE_SELF_SERVICE)
+            self.autotune.observe_service(bucket, service_s)
+        return emission
 
     def take_ready(self):
         """Executor protocol (rnb_tpu.runner): a completed fused
@@ -1266,7 +1336,17 @@ class R2P1DFusingLoader(R2P1DLoader):
             if not self._inflight:
                 return 0.0  # nothing else can fuse: emit now
             waited = time.monotonic() - self._ready[0].t_ready
-            remaining = max(0.0, self.max_hold_ms / 1000.0 - waited)
+            if self.autotune is not None:
+                # the executor's poll clamp derives from the
+                # controller's deadline, not the static constant —
+                # peek: this runs every poll tick, and counting ticks
+                # as decisions would corrupt the Autotune: accounting
+                dec = self.autotune.peek(
+                    len(self._ready),
+                    sum(rec.handle.n for rec in self._ready), waited)
+                remaining = max(0.0, dec.hold_s - waited)
+            else:
+                remaining = max(0.0, self.max_hold_ms / 1000.0 - waited)
             # two triggers race: the hold expiry AND an in-flight
             # decode completing (which can satisfy the fuse/rows/
             # nothing-in-flight rules early) — bound by the sooner
@@ -1293,11 +1373,26 @@ class R2P1DFusingLoader(R2P1DLoader):
         if not self._ready:
             return None
         rows_ready = sum(rec.handle.n for rec in self._ready)
-        if (len(self._ready) >= self.fuse
-                or rows_ready >= self.max_clips
-                or not self._inflight
-                or (time.monotonic() - self._ready[0].t_ready) * 1000.0
-                > self.max_hold_ms):
+        waited_s = time.monotonic() - self._ready[0].t_ready
+        if self.autotune is not None:
+            # controller-supplied deadline and accumulation target
+            # replace the static max_hold_ms / fixed-fuse comparison:
+            # immediate dispatch when growing the batch cannot meet
+            # the latency budget, a grown target when it can — always
+            # capped by the static fuse/row ceilings
+            dec = self.autotune.decide(len(self._ready), rows_ready,
+                                       waited_s)
+            should_emit = (len(self._ready) >= self.fuse
+                           or rows_ready >= self.max_clips
+                           or rows_ready >= dec.target_rows
+                           or not self._inflight
+                           or waited_s >= dec.hold_s)
+        else:
+            should_emit = (len(self._ready) >= self.fuse
+                           or rows_ready >= self.max_clips
+                           or not self._inflight
+                           or waited_s * 1000.0 > self.max_hold_ms)
+        if should_emit:
             self._emit()
             return self._pop_ready()
         return None
@@ -1326,6 +1421,11 @@ class R2P1DFusingLoader(R2P1DLoader):
                     return out
                 return None, None, None
         handle = self._decode_submit(video, time_card)
+        if self.autotune is not None:
+            # rows-per-request estimator: converts a bucket-growth
+            # target into a residual request count (coalesced
+            # followers add cards, not rows, so they do not feed this)
+            self.autotune.observe_rows(handle.n)
         rec = _FuseRecord(handle, video, time_card, key=key)
         if key is not None:
             self._inflight_keys.put(key, rec)

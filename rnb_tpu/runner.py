@@ -72,20 +72,30 @@ QUEUE_POLL_S = 0.05
 MIN_POLL_S = 0.001
 
 
-def poll_timeout(model) -> float:
-    """Queue-poll timeout for an accumulator stage: the stage's own
-    next deadline (hold-timeout expiry / harvest tick), clamped to
-    [MIN_POLL_S, QUEUE_POLL_S]. Stages without deadlines poll at the
-    coarse default. The round-5 frontier measured the fixed 50 ms
-    poll as the light-load p99 floor (57-61 ms tails against a 5-8 ms
-    configured hold) — emissions could only fire on a poll tick."""
+def poll_plan(model):
+    """``(timeout_s, holding)`` for an accumulator stage's queue poll:
+    the stage's own next deadline (hold-timeout expiry / harvest tick
+    — under autotune, the controller's next deadline), clamped to
+    [MIN_POLL_S, QUEUE_POLL_S], plus whether the stage is actually
+    holding work (drives the exec*.hold_wait/queue_get hostprof
+    split: waiting to fill a batch is not starvation). Stages without
+    deadlines poll at the coarse default. The round-5 frontier
+    measured the fixed 50 ms poll as the light-load p99 floor
+    (57-61 ms tails against a 5-8 ms configured hold) — emissions
+    could only fire on a poll tick."""
     deadline = None
     next_deadline = getattr(model, "next_deadline_s", None)
     if next_deadline is not None:
         deadline = next_deadline()
     if deadline is None:
-        return QUEUE_POLL_S
-    return min(QUEUE_POLL_S, max(MIN_POLL_S, deadline))
+        return QUEUE_POLL_S, False
+    return min(QUEUE_POLL_S, max(MIN_POLL_S, deadline)), True
+
+
+def poll_timeout(model) -> float:
+    """The timeout half of :func:`poll_plan` (kept as the stable
+    public face the deadline tests exercise)."""
+    return poll_plan(model)[0]
 #: sentinel for "an idle poll produced an emission" in the hot loop
 _IDLE_EMIT = object()
 
@@ -140,6 +150,15 @@ class RunnerContext:
     #: staging on a loader step) append their final pool snapshot here
     #: (BenchmarkResult + log-meta `Staging:` line)
     staging_sink: Optional[List] = None
+    #: load-adaptive batching (rnb_tpu.autotune): the job's
+    #: AutotuneSettings when this step participates (root 'autotune'
+    #: config key, per-step opt-out), or None. The executor calls
+    #: model.enable_autotune() on supporting stages and feeds the
+    #: controller's estimators from the hot loop.
+    autotune: Optional[Any] = None
+    #: controller-owning stages append their final decision/deadline
+    #: counters here (BenchmarkResult + log-meta `Autotune:` line)
+    autotune_sink: Optional[List] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -294,6 +313,7 @@ def runner(ctx: RunnerContext) -> None:
     summary = TimeCardSummary() if ctx.out_queues is None else None
     progress_bar = None
     declared_shapes = None
+    controller = None
     try:
         model_class = load_class(ctx.model_class_path)
         model = model_class(ctx.device, **ctx.model_kwargs)
@@ -304,6 +324,13 @@ def runner(ctx: RunnerContext) -> None:
             selector_class = load_class(ctx.queue_selector_path)
             selector = selector_class(len(ctx.out_queues))
             selector.bind_stage(model)
+        if ctx.autotune is not None \
+                and getattr(model, "SUPPORTS_AUTOTUNE", False):
+            # load-adaptive batching (rnb_tpu.autotune): the stage
+            # builds its controller over its OWN warmed bucket set —
+            # a bucket restriction it never warms is rejected here
+            # (and statically by rnb-lint RNB-G006)
+            controller = model.enable_autotune(ctx.autotune)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
@@ -342,11 +369,17 @@ def runner(ctx: RunnerContext) -> None:
     old_counter_value = 0
     # loop-invariant hostprof section names, formatted once
     sec_queue_get = "exec%d.queue_get" % ctx.step_idx
+    sec_hold_wait = "exec%d.hold_wait" % ctx.step_idx
     sec_model_call = "exec%d.model_call" % ctx.step_idx
     sec_device_sync = "exec%d.device_sync" % ctx.step_idx
     sec_ring_publish = "exec%d.ring_publish" % ctx.step_idx
     sec_bookkeeping = "exec%d.bookkeeping" % ctx.step_idx
     sec_enqueue = "exec%d.route+enqueue" % ctx.step_idx
+    # loop-invariant stamp keys the autotune service feed reads (these
+    # are lookups of stamps the record() sites below write, not new
+    # stamp sites)
+    key_inf_start = "inference%d_start" % ctx.step_idx
+    key_inf_finish = "inference%d_finish" % ctx.step_idx
 
     # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
     # first stage exposing submit()/complete() gets its next requests'
@@ -429,10 +462,22 @@ def runner(ctx: RunnerContext) -> None:
                         continue
                 else:
                     try:
-                        with hostprof.section(sec_queue_get):
-                            item = ctx.in_queue.get(
-                                timeout=(QUEUE_POLL_S if idle_poll is None
-                                         else poll_timeout(model)))
+                        if idle_poll is None:
+                            with hostprof.section(sec_queue_get):
+                                item = ctx.in_queue.get(
+                                    timeout=QUEUE_POLL_S)
+                        else:
+                            # accumulator stages: the poll window
+                            # shrinks to the stage's next deadline
+                            # (under autotune, the controller's), and
+                            # time spent blocked while the stage HOLDS
+                            # work is batch-fill wait, not queue
+                            # starvation — hostprof splits the two
+                            timeout, holding = poll_plan(model)
+                            with hostprof.section(
+                                    sec_hold_wait if holding
+                                    else sec_queue_get):
+                                item = ctx.in_queue.get(timeout=timeout)
                     except queue.Empty:
                         # idle tick: give accumulator stages (fusing
                         # loader) a chance to emit on hold-timeout —
@@ -457,6 +502,14 @@ def runner(ctx: RunnerContext) -> None:
                         signal, non_tensors, time_card = item
                         time_card.add_device(ctx.device.label)
                         time_card.record("runner%d_start" % ctx.step_idx)
+                        if controller is not None:
+                            # arrival-rate estimator: the client's
+                            # enqueue stamps (pure host arithmetic,
+                            # no clock call)
+                            for tc in _cards_of(time_card):
+                                t_enq = tc.timings.get("enqueue_filename")
+                                if t_enq is not None:
+                                    controller.observe_enqueue(t_enq)
 
                         if signal is not None:
                             ring = ctx.input_rings[signal.group_idx][
@@ -573,6 +626,36 @@ def runner(ctx: RunnerContext) -> None:
                     with hostprof.section(sec_device_sync):
                         _block_on(tensors_out)
                 time_card.record("inference%d_finish" % ctx.step_idx)
+                if controller is not None and tensors_out \
+                        and flushed is None \
+                        and not getattr(model, "AUTOTUNE_SELF_SERVICE",
+                                        False):
+                    # service-time estimator, per emitted row bucket:
+                    # the LAST-swallowed constituent's start -> the
+                    # emission finish. Accurate for stages where
+                    # swallow and emit happen in the same call (the
+                    # Batcher — earlier constituents' spans include
+                    # their accumulate hold, which must not read as
+                    # service). Stages whose emissions complete
+                    # asynchronously (the fusing loader under
+                    # transfer_async, where every emission surfaces
+                    # via take_ready and `flushed` is never None)
+                    # self-report their close->ready span instead and
+                    # opt out via AUTOTUNE_SELF_SERVICE.
+                    # Arrival-triggered dispatches only: on `flushed`
+                    # emissions (idle-tick hold expiry, EOS flush,
+                    # async-transfer drains) the last start predates
+                    # the dispatch by up to the hold/poll gap, and
+                    # feeding that span would inflate the EWMA until
+                    # the controller stopped holding at all
+                    cards = _cards_of(time_card)
+                    t_fin = cards[0].timings.get(key_inf_finish)
+                    if t_fin is not None:
+                        t_sta = max(tc.timings.get(key_inf_start, t_fin)
+                                    for tc in cards)
+                        controller.observe_service(
+                            int(tensors_out[0].data.shape[0]),
+                            max(0.0, t_fin - t_sta))
 
                 out_queue = None
                 if ctx.out_queues is not None:
@@ -671,9 +754,15 @@ def runner(ctx: RunnerContext) -> None:
                                 else:
                                     out_queue.put_nowait(item)
                     except queue.Full:
-                        print("[WARNING] queue between steps %d and %d is "
-                              "full; aborting"
-                              % (ctx.step_idx, ctx.step_idx + 1))
+                        # counted telemetry, not a stray stdout line:
+                        # the per-edge overflow count lands in
+                        # BenchmarkResult.queue_overflows and the
+                        # log-meta 'Queue overflows:' line; the
+                        # termination flag still says the job aborted
+                        if ctx.fault_stats is not None:
+                            ctx.fault_stats.record_overflow(
+                                "step%d->step%d"
+                                % (ctx.step_idx, ctx.step_idx + 1))
                         ctx.termination.raise_flag(
                             TerminationFlag.FRAME_QUEUE_FULL)
                         break
@@ -751,6 +840,13 @@ def runner(ctx: RunnerContext) -> None:
                 and getattr(model, "staging", None) is not None):
             try:
                 ctx.staging_sink.append(model.staging.snapshot())
+            except Exception:
+                traceback.print_exc()
+        # controller-owning stages report their final decision counters
+        # the same way (the stage is drained; counters are stable)
+        if ctx.autotune_sink is not None and controller is not None:
+            try:
+                ctx.autotune_sink.append(controller.snapshot())
             except Exception:
                 traceback.print_exc()
         try:

@@ -139,6 +139,13 @@ class StageModel:
     #: constructor parameters with this class's own.
     FORWARDS_CONFIG_TO: Tuple[type, ...] = ()
 
+    #: True for stages whose batching knobs the load-adaptive
+    #: controller (rnb_tpu.autotune, root 'autotune' config key) can
+    #: drive — they implement ``enable_autotune(settings)`` and route
+    #: their accumulate/emit decisions through the controller. The
+    #: executor and the static graph checker both key off this.
+    SUPPORTS_AUTOTUNE = False
+
     def __init__(self, device, **kwargs):
         self.device = device
 

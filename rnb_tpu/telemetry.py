@@ -113,11 +113,19 @@ META_LINE_REGISTRY = (
               "JSON per-reason contained-failure counts"),
     StampSpec("Shed sites:", "rnb_tpu/benchmark.py",
               "JSON per-site shed counts"),
+    StampSpec("Queue overflows:", "rnb_tpu/benchmark.py",
+              "JSON per-edge abort-policy queue-overflow counts"),
     StampSpec("Cache:", "rnb_tpu/benchmark.py",
               "clip-cache counters (cache-enabled runs only)"),
     StampSpec("Staging:", "rnb_tpu/benchmark.py",
               "zero-copy decode-staging pool counters "
               "(staging-enabled runs only)"),
+    StampSpec("Autotune:", "rnb_tpu/benchmark.py",
+              "load-adaptive batching controller counters "
+              "(autotune-enabled runs only)"),
+    StampSpec("Autotune buckets:", "rnb_tpu/benchmark.py",
+              "JSON per-chosen-bucket emission counts "
+              "(autotune-enabled runs only)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry

@@ -10,8 +10,10 @@ scheme; see SURVEY.md §2.1 #15):
   ``Args: Namespace(...)`` repr, start/end wall-clock timestamps, the
   termination flag, a ``Faults: num_failed=K num_shed=S num_retries=R``
   accounting line, (when any request failed) a ``Failure reasons:``
-  JSON line with per-reason counts, and — on cache-/staging-enabled
-  runs only — the ``Cache:`` and ``Staging:`` counter lines.
+  JSON line with per-reason counts, (when a queue overflowed under the
+  abort policy) a ``Queue overflows:`` JSON per-edge line, and — on
+  cache-/staging-/autotune-enabled runs only — the ``Cache:``,
+  ``Staging:``, ``Autotune:`` and ``Autotune buckets:`` counter lines.
 * ``logs/<job_id>/<device>-group<g>-<i>.txt`` — one whitespace table
   per final-step instance (rnb_tpu/telemetry.py TimeCardSummary
   .save_full_report): a header of event keys followed by per-step
@@ -72,12 +74,29 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["staging_" + key] = int(val)
+        elif line.startswith("Autotune buckets:"):
+            # JSON {row-bucket: emission count} — must be matched
+            # before the "Autotune:" prefix below
+            import json
+            meta["autotune_bucket_counts"] = {
+                key: int(val) for key, val
+                in json.loads(line.split(":", 1)[1]).items()}
+        elif line.startswith("Autotune:"):
+            # "Autotune: decisions=D immediate=I held=H emissions=E
+            #  deadline_us_min=N deadline_us_max=X deadline_us_sum=S"
+            # — written only by autotune-enabled runs (rnb_tpu.autotune)
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["autotune_" + key] = int(val)
         elif line.startswith("Failure reasons:"):
             import json
             meta["failure_reasons"] = json.loads(line.split(":", 1)[1])
         elif line.startswith("Shed sites:"):
             import json
             meta["shed_sites"] = json.loads(line.split(":", 1)[1])
+        elif line.startswith("Queue overflows:"):
+            import json
+            meta["queue_overflows"] = json.loads(line.split(":", 1)[1])
         elif line.startswith("Args:"):
             for key, raw in _ARGS_RE.findall(line):
                 raw = raw.strip()
@@ -409,7 +428,117 @@ def check_job(job_dir: str) -> List[str]:
                 "(a realloc needs a confirmed staged transfer)"
                 % (meta["staging_reallocs"],
                    meta["staging_staged_batches"]))
+
+    # autotune accounting (rnb_tpu.autotune): every batched emission
+    # under autotune is covered by a controller decision (forced drains
+    # are back-filled as immediate decisions), decisions split exactly
+    # into immediate/held verdicts, the held-deadline histogram must be
+    # internally consistent, and chosen buckets must be a subset of
+    # the buckets the config warms — a chosen un-warmed bucket would
+    # have been a silent mid-run recompile
+    if "autotune_decisions" in meta:
+        for key in ("autotune_decisions", "autotune_immediate",
+                    "autotune_held", "autotune_emissions",
+                    "autotune_deadline_us_min",
+                    "autotune_deadline_us_max",
+                    "autotune_deadline_us_sum"):
+            if meta.get(key, 0) < 0:
+                problems.append("negative %s" % key)
+        decisions = meta.get("autotune_decisions", 0)
+        immediate = meta.get("autotune_immediate", 0)
+        held = meta.get("autotune_held", 0)
+        emissions = meta.get("autotune_emissions", 0)
+        if immediate + held != decisions:
+            problems.append(
+                "autotune_immediate=%d + autotune_held=%d != "
+                "autotune_decisions=%d (every decision has exactly one "
+                "verdict)" % (immediate, held, decisions))
+        if emissions > decisions:
+            problems.append(
+                "autotune_emissions=%d exceeds autotune_decisions=%d "
+                "(every emission under autotune is covered by a "
+                "decision)" % (emissions, decisions))
+        buckets = meta.get("autotune_bucket_counts", {})
+        if sum(buckets.values()) != emissions:
+            problems.append(
+                "autotune bucket counts sum to %d but "
+                "autotune_emissions=%d (every emission is attributed "
+                "to its chosen bucket)"
+                % (sum(buckets.values()), emissions))
+        d_min = meta.get("autotune_deadline_us_min", 0)
+        d_max = meta.get("autotune_deadline_us_max", 0)
+        d_sum = meta.get("autotune_deadline_us_sum", 0)
+        if held > 0:
+            if d_min > d_max:
+                problems.append(
+                    "autotune_deadline_us_min=%d exceeds "
+                    "autotune_deadline_us_max=%d" % (d_min, d_max))
+            if not held * d_min <= d_sum <= held * d_max:
+                problems.append(
+                    "autotune_deadline_us_sum=%d outside "
+                    "[held*min, held*max]=[%d, %d]"
+                    % (d_sum, held * d_min, held * d_max))
+        elif d_sum != 0:
+            problems.append(
+                "autotune_deadline_us_sum=%d with autotune_held=0 "
+                "(only held decisions enter the deadline histogram)"
+                % d_sum)
+        configured = _configured_buckets(job_dir)
+        if buckets and configured:
+            rogue = sorted(int(b) for b in buckets
+                           if int(b) not in configured)
+            if rogue:
+                problems.append(
+                    "autotune chose row bucket(s) %s the config never "
+                    "warms (configured: %s) — each would have been a "
+                    "silent mid-run recompile"
+                    % (rogue, sorted(configured)))
     return problems
+
+
+def _configured_buckets(job_dir: str) -> set:
+    """Every row count the job's config could legally warm: the union
+    of ``row_buckets`` / ``max_clips`` / ``max_rows`` values across
+    steps and groups of the config copy benchmark.py drops into the
+    job dir, plus ``autotune.buckets``. Empty when no config copy is
+    found, or when a step that could participate (not opted out via
+    ``"autotune": false``) declares none of those knobs — its warmed
+    set then comes from constructor defaults the JSON never names, so
+    the vocabulary is incomplete and the subset check is skipped
+    rather than flagging a healthy run."""
+    import json
+    out: set = set()
+    for name in sorted(os.listdir(job_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(job_dir, name)) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(raw, dict) or "pipeline" not in raw:
+            continue
+        autotune = raw.get("autotune")
+        if isinstance(autotune, dict):
+            out.update(int(b) for b in autotune.get("buckets") or [])
+        for step in raw["pipeline"]:
+            if not isinstance(step, dict):
+                continue
+            scopes = [step] + [g for g in step.get("queue_groups", [])
+                               if isinstance(g, dict)]
+            declared: set = set()
+            for scope in scopes:
+                declared.update(int(b) for b
+                                in scope.get("row_buckets") or [])
+                for key in ("max_clips", "max_rows"):
+                    if isinstance(scope.get(key), int):
+                        declared.add(scope[key])
+            if declared:
+                out.update(declared)
+            elif step.get("autotune") is not False:
+                return set()  # default-shaped stage: vocab unknown
+        break
+    return out
 
 
 def print_stamp_registry(out=None) -> None:

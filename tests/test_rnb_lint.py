@@ -36,6 +36,7 @@ GRAPH_CASES = [
     ("bad_g004_selector.json", "RNB-G004"),
     ("bad_g005_key.json", "RNB-G005"),
     ("bad_g006_buckets.json", "RNB-G006"),
+    ("bad_g006_autotune.json", "RNB-G006"),
     ("bad_g007_cache.json", "RNB-G007"),
     ("bad_g008_dtype.json", "RNB-G008"),
 ]
@@ -44,6 +45,14 @@ GRAPH_CASES = [
 def test_good_config_fixture_is_clean():
     from rnb_tpu.analysis.graph import check_config
     assert check_config(_fixture("good.json")) == []
+
+
+def test_good_autotune_fixture_is_clean():
+    # the root 'autotune' key and the reserved per-step opt-out are
+    # consumed by the checker: no RNB-G005 "unconsumed key", and an
+    # in-warmed-set bucket restriction passes RNB-G006
+    from rnb_tpu.analysis.graph import check_config
+    assert check_config(_fixture("good_autotune.json")) == []
 
 
 @pytest.mark.parametrize("name,rule", GRAPH_CASES)
@@ -144,8 +153,11 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Faults: num_failed=%d\\n" % n)\n'
                      'f.write("Failure reasons: %s\\n" % r)\n'
                      'f.write("Shed sites: %s\\n" % s)\n'
+                     'f.write("Queue overflows: %s\\n" % q)\n'
                      'f.write("Cache: hits=%d\\n" % h)\n'
                      'f.write("Staging: slots=%d\\n" % s)\n'
+                     'f.write("Autotune: decisions=%d\\n" % d)\n'
+                     'f.write("Autotune buckets: %s\\n" % b)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -175,7 +187,10 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'coalesced=%d oversize=%d bytes_resident=%d\\n" % y)\n'
         'f.write("Staging: slots=%d slot_bytes=%d acquires=%d '
         'acquire_waits=%d staged_batches=%d copied_batches=%d '
-        'reallocs=%d\\n" % z)\n')
+        'reallocs=%d\\n" % z)\n'
+        'f.write("Autotune: decisions=%d immediate=%d held=%d '
+        'emissions=%d deadline_us_min=%d deadline_us_max=%d '
+        'deadline_us_sum=%d\\n" % w)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}
