@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps
+.PHONY: lint test native stamps trace
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -21,6 +21,12 @@ test:
 # enforces).
 stamps:
 	$(PYTHON) scripts/parse_utils.py --stamps
+
+# Tiny traced end-to-end run + structural validation of the exported
+# Chrome trace (README "Observability"): writes logs/<job>/trace.json
+# ready for ui.perfetto.dev and prints the phase attribution.
+trace:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/trace_demo.py
 
 native:
 	$(MAKE) -C native

@@ -33,6 +33,10 @@ Rules
   TimeCard (``time_card.x = ...``) that is neither a core TimeCard
   attribute nor declared in ``CONTENT_STAMPS`` — it would silently
   fail to survive fork/merge.
+* ``RNB-T008`` unregistered-trace-event: a ``trace.span`` /
+  ``trace.instant`` / ``trace.counter`` / ``trace.name`` site emits an
+  event name ``TRACE_EVENT_REGISTRY`` does not declare (the reverse —
+  a registered event no site emits — is an RNB-T003 dead entry).
 """
 
 from __future__ import annotations
@@ -45,7 +49,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from rnb_tpu.analysis.findings import (Finding, package_py_files,
                                        parse_py)
 from rnb_tpu.telemetry import (CONTENT_STAMPS, META_LINE_REGISTRY,
-                               STAMP_REGISTRY, TABLE_TRAILER_REGISTRY)
+                               STAMP_REGISTRY, TABLE_TRAILER_REGISTRY,
+                               TRACE_EVENT_REGISTRY)
 
 #: core TimeCard attributes (assignments to these are state, not
 #: content stamps)
@@ -55,6 +60,18 @@ TIMECARD_ATTRS = {"timings", "id", "sub_id", "num_parent_timings",
 #: local variable names treated as TimeCard receivers at stamp sites
 TIMECARD_NAMES = {"time_card", "tc", "card", "in_card", "out_card",
                   "merged", "child"}
+
+#: bare-function stamp recorders whose SECOND argument is the stamp
+#: key (card-first calling convention, e.g. the clamped
+#: phase-refinement recorder in rnb_tpu/models/r2p1d/model.py)
+STAMP_WRAPPERS = {"_record_clamped"}
+
+#: modules whose span/instant/counter/name calls emit trace events
+#: (rnb_tpu.trace imported as either name)
+TRACE_MODULE_NAMES = {"trace", "trace_mod"}
+
+#: rnb_tpu.trace entry points that take an event name first
+TRACE_CALL_ATTRS = {"span", "instant", "counter", "name"}
 
 _FMT_PLACEHOLDER = re.compile(r"%[0-9.]*[sdf]")
 
@@ -136,10 +153,21 @@ def extract_stamps(py_paths: Sequence[str], root: str = "."
     for path in py_paths:
         rel = _rel(path, root)
         for node in ast.walk(_parse(path)):
-            if isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Attribute) \
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
                     and node.func.attr == "record" and node.args:
                 literal = _fmt_string(node.args[0])
+                if literal is not None:
+                    out.append((rel, node.lineno, _pattern_of(literal)))
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in STAMP_WRAPPERS \
+                    and len(node.args) >= 2:
+                # stamp-recording helpers take (card, key, ...): the
+                # clamped phase-refinement recorder must stay visible
+                # to the registry cross-check or its stamps would read
+                # as dead entries
+                literal = _fmt_string(node.args[1])
                 if literal is not None:
                     out.append((rel, node.lineno, _pattern_of(literal)))
     return out
@@ -203,7 +231,8 @@ def extract_trailer_kinds(telemetry_path: str, root: str = "."
 #: parse_utils applies when flattening the meta dict)
 COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Staging:": "staging_",
-                         "Autotune:": "autotune_"}
+                         "Autotune:": "autotune_",
+                         "Trace:": "trace_"}
 
 
 def extract_meta_counter_keys(benchmark_path: str) -> Dict[str, Set[str]]:
@@ -223,6 +252,28 @@ def extract_meta_counter_keys(benchmark_path: str) -> Dict[str, Set[str]]:
                     keys.setdefault(prefix, set()).update(
                         key_re.findall(literal))
     return keys
+
+
+def extract_trace_events(py_paths: Sequence[str], root: str = "."
+                         ) -> List[Tuple[str, int, str]]:
+    """Every literal event name passed to a tracing entry point
+    (``trace.span(...)`` / ``.instant`` / ``.counter`` / ``.name``):
+    -> [(relpath, line, pattern)]. Prebuilt names flowing through
+    variables are covered at their ``trace.name`` build site."""
+    out = []
+    for path in py_paths:
+        rel = _rel(path, root)
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in TRACE_CALL_ATTRS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in TRACE_MODULE_NAMES \
+                    and node.args:
+                literal = _fmt_string(node.args[0])
+                if literal is not None:
+                    out.append((rel, node.lineno, _pattern_of(literal)))
+    return out
 
 
 # -- checks -----------------------------------------------------------
@@ -329,6 +380,32 @@ def check_trailers(telemetry_path: str, parse_utils_src: str,
     return findings
 
 
+def check_trace_events(py_paths: Sequence[str], root: str = ".",
+                       registry=TRACE_EVENT_REGISTRY) -> List[Finding]:
+    """RNB-T008 both ways: every emitted trace event name must be
+    declared in ``telemetry.TRACE_EVENT_REGISTRY``, and every declared
+    event must still have an emitting site (else RNB-T003) — so the
+    trace.json vocabulary can neither drift silently nor rot."""
+    findings: List[Finding] = []
+    sites = extract_trace_events(py_paths, root)
+    registered = {spec.pattern for spec in registry}
+    for rel, line, pattern in sites:
+        if pattern not in registered:
+            findings.append(Finding(
+                "RNB-T008", rel, line, pattern,
+                "trace event %r is not declared in "
+                "telemetry.TRACE_EVENT_REGISTRY — register it or "
+                "remove the instrumentation site" % pattern))
+    produced = {pattern for _, _, pattern in sites}
+    for spec in registry:
+        if spec.pattern not in produced:
+            findings.append(Finding(
+                "RNB-T003", "rnb_tpu/telemetry.py", 0, spec.pattern,
+                "registered trace event %r has no remaining "
+                "instrumentation site" % spec.pattern))
+    return findings
+
+
 def check_benchmark_result(benchmark_path: str, root: str = "."
                            ) -> List[Finding]:
     """Every counter written to the Faults:/Cache: log-meta lines must
@@ -365,7 +442,8 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
         if field in ("num_failed", "num_shed", "num_retries") \
                 or field.startswith("cache_") \
                 or field.startswith("staging_") \
-                or field.startswith("autotune_"):
+                or field.startswith("autotune_") \
+                or field.startswith("trace_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,
@@ -389,5 +467,6 @@ def check_repo(root: str = ".") -> List[Finding]:
     findings.extend(check_content_stamps(py_files, root))
     findings.extend(check_meta_lines(benchmark, parse_src, root))
     findings.extend(check_trailers(telemetry, parse_src, root))
+    findings.extend(check_trace_events(py_files, root))
     findings.extend(check_benchmark_result(benchmark, root))
     return findings

@@ -66,6 +66,8 @@ import dataclasses
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from rnb_tpu import trace
+
 #: defaults for the optional keys of the ``autotune`` root config
 AUTOTUNE_DEFAULTS = {
     "slo_ms": 50.0,
@@ -314,6 +316,14 @@ class BatchController:
         the ``Autotune:`` accounting; deadline-only queries must use
         :meth:`peek`."""
         dec = self.peek(n_ready, rows_ready, oldest_wait_s)
+        if trace.ACTIVE is not None:
+            # decision marker on the deciding thread's trace track
+            # (rnb_tpu.trace; args allocated only while tracing) —
+            # still no clock reads or RNG on the decision path itself
+            trace.instant("autotune.decision", args={
+                "verdict": "immediate" if dec.immediate else "held",
+                "target_rows": dec.target_rows,
+                "hold_ms": dec.hold_s * 1000.0})
         self._decisions += 1
         self._decided_since_emit = True
         if dec.immediate:

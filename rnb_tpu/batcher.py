@@ -18,6 +18,7 @@ import time
 
 import numpy as np
 
+from rnb_tpu import trace
 from rnb_tpu.autotune import BatchController
 from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
 from rnb_tpu.telemetry import TimeCardList
@@ -229,6 +230,12 @@ class Batcher(StageModel):
         return max_rows
 
     def _emit_fused(self):
+        if trace.ACTIVE is not None:
+            # timeline marker per fused dispatch (args allocated only
+            # while tracing): how many requests/rows this batch fused
+            trace.instant("batcher.emit", args={
+                "requests": len(self._time_cards),
+                "rows": sum(parts[0].valid for parts in self._tensors)})
         fused = []
         for pos, parts in enumerate(zip(*self._tensors)):
             valid = sum(pb.valid for pb in parts)

@@ -133,6 +133,18 @@ class BenchmarkResult:
     #: policy (rnb_tpu.control.FaultStats.record_overflow) — the
     #: events that used to be an unparseable stdout warning
     queue_overflows: Dict[str, int] = field(default_factory=dict)
+    #: per-request phase attribution (rnb_tpu.trace): {phase:
+    #: {mean_ms, p99_ms, count}} over steady-state completions,
+    #: phases summing to end-to-end latency per request. Empty unless
+    #: the config's `trace` key enabled tracing (the same gating as
+    #: the log-meta `Phases:` line, keeping trace-off runs byte-
+    #: stable).
+    phases: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    #: trace export accounting: events written to logs/<job>/
+    #: trace.json and events dropped at the max_events cap (both 0 on
+    #: trace-off runs)
+    trace_events: int = 0
+    trace_dropped: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -153,13 +165,20 @@ def run_benchmark(config_path: str,
     # (SURVEY.md §2.4 TPU mapping; no-op for single-host runs)
     from rnb_tpu.parallel.distributed import maybe_initialize
     maybe_initialize()
+    from rnb_tpu import trace as trace_mod
     from rnb_tpu.client import bulk_client, poisson_client
     from rnb_tpu.config import load_config
     from rnb_tpu.control import (ChannelFabric, FaultStats,
                                  InferenceCounter, TerminationState)
     from rnb_tpu.faults import FaultPlan
-    from rnb_tpu.runner import RunnerContext, runner
+    from rnb_tpu.runner import NUM_SUMMARY_SKIPS, RunnerContext, runner
     from rnb_tpu.telemetry import logmeta, logroot
+
+    # defensive: a previous run that died mid-trace must not leave its
+    # tracer active — this run's instrumentation would otherwise write
+    # into a dead collector (and un-traced runs would stop being
+    # byte-stable)
+    trace_mod.ACTIVE = None
 
     config = load_config(config_path)
     config.check_devices()
@@ -238,6 +257,30 @@ def run_benchmark(config_path: str,
                                 + max(NUM_EXIT_MARKERS, num_runners) + 1)
     fabric = ChannelFabric(config, effective_queue_size)
 
+    # unified pipeline tracing (rnb_tpu.trace, root 'trace' config
+    # key): one per-job collector every thread role records spans
+    # into, plus a low-rate background sampler over the inter-stage
+    # queue depths (stage-owned sources — staging occupancy, in-flight
+    # decode counts — register in the runner via enable_trace)
+    tracer = None
+    trace_settings = trace_mod.TraceSettings.from_config(config.trace)
+    if trace_settings is not None:
+        tracer = trace_mod.Tracer(trace_settings)
+        tracer.add_counter_source(
+            trace_mod.name("queue.filename.depth"),
+            fabric.get_filename_queue().qsize)
+        edge_idx = 0
+        for step_queues in fabric.queues:
+            # edge ordinal in step-major enumeration order (queue
+            # indices may legally repeat across steps, so the ordinal
+            # — not the config's queue index — keys the counter track)
+            for q_idx in sorted(step_queues):
+                tracer.add_counter_source(
+                    trace_mod.name("queue.e%d.depth", edge_idx),
+                    step_queues[q_idx].qsize)
+                edge_idx += 1
+        trace_mod.ACTIVE = tracer
+
     threads = []
     client_kwargs = dict(overload_policy=config.overload_policy,
                          fault_stats=fault_stats, counter=counter,
@@ -305,6 +348,7 @@ def run_benchmark(config_path: str,
                     autotune=(autotune_settings if step.autotune
                               else None),
                     autotune_sink=autotune_sink,
+                    tracer=tracer,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -357,6 +401,11 @@ def run_benchmark(config_path: str,
         # multi-run process (config sweep) must not fold earlier runs'
         # totals (or this run's warmup) into this run's report
         hostprof.reset()
+    if tracer is not None:
+        # occupancy sampling covers the measured window (plus the
+        # short drain); started here so warm-up/compile never lands
+        # in the timeline
+        tracer.start_sampler()
     sta_bar.wait()
     ru_start = resource.getrusage(resource.RUSAGE_SELF)
     time_start = time.time()
@@ -415,6 +464,35 @@ def run_benchmark(config_path: str,
 
     for t in threads:
         t.join(timeout=60)
+
+    # trace export: every thread is drained, so the event set is
+    # final; clear the module hook BEFORE exporting so a later run in
+    # this process can never write into this job's collector
+    trace_events = trace_dropped = 0
+    if tracer is not None:
+        trace_mod.ACTIVE = None
+        tracer.stop_sampler()
+        trace_path = os.path.join(logroot(job_id, base=log_base),
+                                  "trace.json")
+        trace_events = tracer.export(trace_path, job_id)
+        trace_dropped = tracer.dropped
+        if print_progress:
+            print("Trace: %d event(s) -> %s (%d dropped at the "
+                  "max_events cap)"
+                  % (trace_events, trace_path, trace_dropped))
+
+    # per-request phase attribution (rnb_tpu.trace): aggregated over
+    # every final-step instance's steady-state records — surfaced only
+    # on trace-enabled runs so earlier logs stay byte-stable
+    phases_stats = None
+    if tracer is not None and summary_sink:
+        from rnb_tpu.trace import phase_stats, sorted_phases
+        merged: Dict[str, list] = {}
+        for s in summary_sink:
+            for phase, vals in s.phase_samples(
+                    NUM_SUMMARY_SKIPS).items():
+                merged.setdefault(phase, []).extend(vals)
+        phases_stats = phase_stats(merged) or None
 
     # decoded-clip cache accounting: cache-owning stages appended
     # their final snapshots before the finish barrier (rnb_tpu.runner)
@@ -503,6 +581,18 @@ def run_benchmark(config_path: str,
                 f.write("Autotune buckets: %s\n"
                         % json.dumps(autotune_stats["bucket_counts"],
                                      sort_keys=True))
+        if tracer is not None:
+            # trace-export accounting: events written to trace.json
+            # and events dropped at the max_events cap — parse_utils
+            # --check cross-checks the count against the artifact
+            f.write("Trace: events=%d dropped=%d\n"
+                    % (trace_events, trace_dropped))
+        if phases_stats is not None:
+            # only trace-enabled runs carry the line: per-phase
+            # mean/p99/count, phases summing to end-to-end latency
+            # per request (parse_utils --check asserts it)
+            f.write("Phases: %s\n"
+                    % json.dumps(phases_stats, sort_keys=True))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -518,7 +608,6 @@ def run_benchmark(config_path: str,
 
     # aggregate end-to-end latency percentiles over every final-step
     # instance, skipping warm records per the summary convention
-    from rnb_tpu.runner import NUM_SUMMARY_SKIPS
     from rnb_tpu.telemetry import latency_percentiles
     latencies = []
     clips_completed = 0
@@ -561,6 +650,12 @@ def run_benchmark(config_path: str,
                  autotune_stats["emissions"],
                  json.dumps(autotune_stats["bucket_counts"],
                             sort_keys=True)))
+    if phases_stats is not None and print_progress:
+        print("Phases (per-request attribution, mean/p99 ms):")
+        for phase in sorted_phases(phases_stats):
+            s = phases_stats[phase]
+            print("  %-18s %8.3f / %8.3f  (n=%d)"
+                  % (phase, s["mean_ms"], s["p99_ms"], s["count"]))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -631,6 +726,9 @@ def run_benchmark(config_path: str,
         autotune_bucket_counts=(dict(autotune_stats["bucket_counts"])
                                 if autotune_stats else {}),
         queue_overflows=dict(faults["overflow_sites"]),
+        phases=dict(phases_stats) if phases_stats else {},
+        trace_events=trace_events,
+        trace_dropped=trace_dropped,
     )
 
 
@@ -706,6 +804,9 @@ def main(argv=None) -> int:
                  if cfg.autotune else "none",
                  "; opted-out steps: %s" % opted_out
                  if opted_out else ""))
+        print("trace: %s"
+              % (json.dumps(cfg.trace, sort_keys=True)
+                 if cfg.trace else "none"))
         print("rnb_tpu is ready to go!")
         return 0
 

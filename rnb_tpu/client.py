@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+from rnb_tpu import trace
 from rnb_tpu.control import NUM_EXIT_MARKERS, FaultStats, \
     InferenceCounter, TerminationFlag, TerminationState, \
     dispose_requests, send_exit_markers
@@ -89,6 +90,11 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
                 video_path = next(iterator)
                 time_card = TimeCard(video_count)
                 time_card.record("enqueue_filename")
+                # flow anchor for the request's cross-stage trace
+                # chain + an event-driven arrival-rate counter track
+                # (rnb_tpu.trace; one None test each when tracing off)
+                trace.instant("client.enqueue", rid=video_count)
+                trace.counter("client.enqueued", video_count + 1)
                 try:
                     filename_queue.put_nowait((None, video_path, time_card))
                 except queue.Full:
@@ -97,6 +103,7 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
                         # keep the stream alive (it still consumes an
                         # id and counts toward the run target — the
                         # pipeline owes it no further work)
+                        trace.instant("client.shed", rid=video_count)
                         time_card.mark_shed(SHED_SITE)
                         if fault_stats is not None:
                             fault_stats.record_shed(SHED_SITE)

@@ -36,7 +36,7 @@ RESERVED_KEYWORDS = [
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
-    "_comment",
+    "trace", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -45,6 +45,9 @@ POPULARITY_KEYWORDS = ["dist", "s", "universe"]
 #: keys a root 'autotune' object may carry (rnb_tpu.autotune)
 AUTOTUNE_KEYWORDS = ["enabled", "slo_ms", "ewma_alpha", "min_hold_ms",
                      "max_hold_ms", "buckets"]
+
+#: keys a root 'trace' object may carry (rnb_tpu.trace)
+TRACE_KEYWORDS = ["enabled", "sample_hz", "max_events"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -150,6 +153,14 @@ class PipelineConfig:
     #: builds rnb_tpu.autotune.AutotuneSettings from it and every
     #: batching stage not opted out gets a BatchController
     autotune: Optional[Dict[str, Any]] = None
+    #: validated tracing spec ({"enabled": .., "sample_hz": ..,
+    #: "max_events": ..}), or None; when enabled the launcher builds
+    #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
+    #: a background sampler records queue/slot occupancy, and the job
+    #: dir gains a Perfetto-loadable trace.json plus per-request
+    #: phase attribution (Phases: line, `# phases` trailers). Absent
+    #: => logs are byte-stable with the pre-trace schema.
+    trace: Optional[Dict[str, Any]] = None
 
     @property
     def num_steps(self) -> int:
@@ -262,6 +273,27 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     and len(set(buckets)) == len(buckets),
                     "'autotune.buckets' must be a non-empty list of "
                     "distinct positive row counts, got %r" % (buckets,))
+
+    trace = raw.get("trace")
+    if trace is not None:
+        _expect(isinstance(trace, dict), "'trace' must be an object")
+        unknown_tr = sorted(set(trace) - set(TRACE_KEYWORDS))
+        _expect(not unknown_tr,
+                "'trace' has unknown key(s) %s — keys are %s"
+                % (unknown_tr, TRACE_KEYWORDS))
+        _expect(isinstance(trace.get("enabled", True), bool),
+                "'trace.enabled' must be a boolean")
+        sample_hz = trace.get("sample_hz", 20.0)
+        _expect(isinstance(sample_hz, (int, float))
+                and not isinstance(sample_hz, bool) and sample_hz >= 0,
+                "'trace.sample_hz' must be a non-negative number "
+                "(0 disables the occupancy sampler), got %r"
+                % (sample_hz,))
+        max_events = trace.get("max_events", 200000)
+        _expect(isinstance(max_events, int)
+                and not isinstance(max_events, bool) and max_events >= 1,
+                "'trace.max_events' must be a positive integer, got %r"
+                % (max_events,))
 
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
@@ -444,4 +476,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           fault_containment=fault_containment,
                           fault_plan=fault_plan,
                           popularity=popularity,
-                          autotune=autotune)
+                          autotune=autotune,
+                          trace=trace)

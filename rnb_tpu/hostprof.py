@@ -32,14 +32,22 @@ from typing import Dict, List, Tuple
 ENABLED = bool(os.environ.get("RNB_HOST_PROFILE"))
 
 _lock = threading.Lock()
-_acc: Dict[str, List[float]] = {}  # name -> [total_s, calls]
+#: (name, thread_role) -> [total_s, calls]. The role is the recording
+#: thread's name — stable per worker ("runner-s0-g0-i0", "client",
+#: "rnb-transfer", "rnb-decode_3"), so one section shared by several
+#: thread roles (loader.cache_insert from the executor AND the
+#: transfer worker) splits per role instead of folding together.
+_acc: Dict[Tuple[str, str], List[float]] = {}
 
 
-def add(name: str, dt: float) -> None:
+def add(name: str, dt: float, role: str = None) -> None:
+    if role is None:
+        role = threading.current_thread().name
+    key = (name, role)
     with _lock:
-        entry = _acc.get(name)
+        entry = _acc.get(key)
         if entry is None:
-            _acc[name] = [dt, 1]
+            _acc[key] = [dt, 1]
         else:
             entry[0] += dt
             entry[1] += 1
@@ -82,21 +90,37 @@ def reset() -> None:
 
 
 def snapshot() -> Dict[str, Tuple[float, int]]:
+    """Role-less view (the historical schema): name -> (total_s,
+    calls) summed across every thread role that hit the section."""
+    out: Dict[str, List[float]] = {}
+    with _lock:
+        for (name, _role), (secs, n) in _acc.items():
+            entry = out.setdefault(name, [0.0, 0])
+            entry[0] += secs
+            entry[1] += n
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def snapshot_by_role() -> Dict[Tuple[str, str], Tuple[float, int]]:
+    """Full-resolution view: (name, thread_role) -> (total_s, calls)."""
     with _lock:
         return {k: (v[0], v[1]) for k, v in _acc.items()}
 
 
-def totals(prefix: str) -> Tuple[float, int]:
+def totals(prefix: str, role: str = None) -> Tuple[float, int]:
     """Summed ``(seconds, calls)`` over sections whose name starts
     with ``prefix`` — e.g. ``totals("loader.emit")`` for the whole
     emission-assembly family, or ``totals("transfer.")`` for the
-    transfer-worker thread. The staging acceptance comparison
-    (executor-thread ``loader.device_put`` + emit alloc/copy share,
-    RESULTS.md round 5) is a prefix sum like this."""
+    transfer-worker thread. ``role`` restricts the sum to one thread
+    role (exact thread name), answering "how much of this section ran
+    on THAT thread" — the question the role-less sum cannot. The
+    staging acceptance comparison (executor-thread ``loader.device_put``
+    + emit alloc/copy share, RESULTS.md round 5) is a prefix sum like
+    this."""
     with _lock:
         total_s, calls = 0.0, 0
-        for name, (secs, n) in _acc.items():
-            if name.startswith(prefix):
+        for (name, r), (secs, n) in _acc.items():
+            if name.startswith(prefix) and (role is None or r == role):
                 total_s += secs
                 calls += n
         return total_s, calls
@@ -104,8 +128,14 @@ def totals(prefix: str) -> Tuple[float, int]:
 
 def report_lines(wall_s: float) -> List[str]:
     """Human table: per-section total seconds, share of the window,
-    call count and per-call mean, sorted by total."""
+    call count and per-call mean, sorted by total — the role-less
+    default view. Sections hit from more than one thread role get a
+    per-role breakdown block appended (indented ``name @role`` rows),
+    so a shared section (cache_insert from the executor AND the
+    transfer worker) attributes its time to the threads that spent
+    it."""
     snap = snapshot()
+    by_role = snapshot_by_role()
     lines = ["%-28s %9s %6s %10s %10s"
              % ("section", "total_s", "pct", "calls", "mean_us")]
     for name, (total, calls) in sorted(snap.items(),
@@ -114,4 +144,20 @@ def report_lines(wall_s: float) -> List[str]:
                      % (name, total,
                         100.0 * total / wall_s if wall_s else 0.0,
                         calls, 1e6 * total / calls if calls else 0.0))
+    multi = {}
+    for (name, role), (secs, n) in by_role.items():
+        multi.setdefault(name, []).append((role, secs, n))
+    multi = {name: rows for name, rows in multi.items()
+             if len(rows) > 1}
+    if multi:
+        lines.append("%-28s %9s %6s %10s %10s"
+                     % ("  by thread role", "total_s", "pct", "calls",
+                        "mean_us"))
+        for name in sorted(multi, key=lambda n: -snap[n][0]):
+            for role, secs, n in sorted(multi[name],
+                                        key=lambda row: -row[1]):
+                lines.append("  %-26s %9.3f %5.1f%% %10d %10.1f"
+                             % ("%s @%s" % (name, role), secs,
+                                100.0 * secs / wall_s if wall_s else 0.0,
+                                n, 1e6 * secs / n if n else 0.0))
     return lines

@@ -27,7 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from rnb_tpu import hostprof
+from rnb_tpu import hostprof, trace
 from rnb_tpu.autotune import BatchController
 from rnb_tpu.cache import content_key
 from rnb_tpu.decode import get_decoder
@@ -59,6 +59,19 @@ _cache_lock = threading.Lock()
 _apply_cache: Dict[tuple, Any] = {}
 _params_cache: Dict[tuple, Any] = {}
 _preprocess_cache: Dict[tuple, Any] = {}
+
+
+def _record_clamped(card, key: str, at: float) -> None:
+    """Record a phase-refinement stamp (rnb_tpu.trace) no earlier
+    than the card's latest stamp: each card's stamps must stay
+    time-ordered or attribution gaps go negative — e.g. a coalesced
+    follower can be swallowed AFTER its leader's decode completed, so
+    its decode phase legitimately clamps to zero."""
+    if card.timings:
+        last = next(reversed(card.timings.values()))
+        if at < last:
+            at = last
+    card.record(key, at=at)
 
 
 def _resolve(device):
@@ -302,6 +315,11 @@ class R2P1DLoader(StageModel):
                 raise ValueError("fallback_decode_threads must be >= 1, "
                                  "got %r" % (fallback_decode_threads,))
         self._starts_cache = {}  # video -> clip starts (see _sample_starts)
+        #: pipeline-step index when the job traces (rnb_tpu.trace):
+        #: set via enable_trace(), gates the phase-refinement stamps
+        #: (decode{step}_done / transfer{step}_start/_done) so
+        #: trace-off runs keep the pre-trace stamp schema byte-stable
+        self._trace_step: Optional[int] = None
         # Zero-copy decode staging (rnb_tpu.staging): pre-allocated
         # host slots the native decoder writes straight into, removing
         # the per-request/per-emission bucket-shaped allocation and
@@ -421,6 +439,26 @@ class R2P1DLoader(StageModel):
                     raise
                 print("[rnb-tpu] WARNING: decode warm-up skipped %s: %s"
                       % (path, e))
+
+    def enable_trace(self, tracer, step_idx: int) -> None:
+        """Executor protocol (rnb_tpu.runner): turn on the per-request
+        phase-refinement stamps and register this stage's sampled
+        occupancy sources with the job tracer. Called only on
+        trace-enabled runs."""
+        self._trace_step = int(step_idx)
+        if self.staging is not None:
+            tracer.add_counter_source(
+                trace.name("staging.s%d.free", step_idx),
+                self.staging.available)
+
+    def _stamp_decode_done(self, time_card) -> None:
+        """Phase-refinement: this request's decode completed (trace
+        mode only — one None test otherwise)."""
+        if self._trace_step is None:
+            return
+        _record_clamped(time_card,
+                        "decode%d_done" % self._trace_step, time.time())
+        trace.instant("loader.decode_ready", rid=time_card.id)
 
     def _staging_default_slots(self) -> int:
         """Auto slot budget: the prefetch window plus one transferring
@@ -555,6 +593,15 @@ class R2P1DLoader(StageModel):
         feeds (or as-is for raw/yuv420 consumers)."""
         time_card.num_clips = entry.valid
         time_card.cache_hit = True
+        if self._trace_step is not None:
+            # a hit pays no decode/hold/transfer: zero-length phases
+            # keep every card's key sequence identical per instance
+            # (TimeCardSummary asserts one schema per run)
+            now = time.time()
+            step = self._trace_step
+            _record_clamped(time_card, "decode%d_done" % step, now)
+            _record_clamped(time_card, "transfer%d_start" % step, now)
+            _record_clamped(time_card, "transfer%d_done" % step, now)
         if self._preprocess is None:
             return (PaddedBatch(entry.batch, entry.valid),), None, \
                 time_card
@@ -612,6 +659,9 @@ class R2P1DLoader(StageModel):
             starts = self._sample_starts(decoder, video)
         n = len(starts)
         time_card.num_clips = n
+        # flow anchor: decode kicked off for this request (one None
+        # test when tracing is off, rnb_tpu.trace)
+        trace.instant("loader.decode_submit", rid=time_card.id)
         # trust the backend get_decoder() chose: a .y4m path whose file
         # vanished resolves to SyntheticDecoder there, and submitting it
         # to the native pool anyway would kill the run the synchronous
@@ -651,11 +701,16 @@ class R2P1DLoader(StageModel):
                 thread_name_prefix="rnb-decode")
 
         handle = _DecodeHandle(None, n)
+        rid = time_card.id
 
         def _work():
             # hand the decoded batch to the handle directly — no
-            # staging copy into the preallocated buffer
-            handle.out = self._decode_sync(decoder, video, starts)
+            # staging copy into the preallocated buffer (the span puts
+            # the decode body on the rnb-decode thread's trace track;
+            # native-pool decodes run in C++ and are delimited by the
+            # submit/ready instants instead)
+            with trace.span("loader.decode", rid):
+                handle.out = self._decode_sync(decoder, video, starts)
 
         handle.future = self._fallback_pool.submit(_work)
         return handle
@@ -678,7 +733,16 @@ class R2P1DLoader(StageModel):
         else:
             padded = np.zeros(target, dtype=np.uint8)
             padded[:n] = clips
-        device_u8 = jax.device_put(padded, self._jax_device)
+        if self._trace_step is not None:
+            _record_clamped(time_card,
+                            "transfer%d_start" % self._trace_step,
+                            time.time())
+        with trace.span("loader.transfer", time_card.id):
+            device_u8 = jax.device_put(padded, self._jax_device)
+        if self._trace_step is not None:
+            _record_clamped(time_card,
+                            "transfer%d_done" % self._trace_step,
+                            time.time())
         if cache_key is not None and self.cache is not None:
             # zero-copy insert: the padded device array IS the cached
             # value (immutable jax.Array) — no extra transfer
@@ -706,10 +770,19 @@ class R2P1DLoader(StageModel):
         if n < slot.buf.shape[0]:
             slot.buf[n:] = 0
         self.staging.begin_transfer(slot)
-        with hostprof.section("loader.device_put"):
+        if self._trace_step is not None:
+            _record_clamped(time_card,
+                            "transfer%d_start" % self._trace_step,
+                            time.time())
+        with hostprof.section("loader.device_put"), \
+                trace.span("loader.transfer", time_card.id):
             device_u8 = jax.device_put(slot.buf, self._jax_device)
         self.staging.finish_transfer(slot, device_u8)
         self.staging.note_staged()
+        if self._trace_step is not None:
+            _record_clamped(time_card,
+                            "transfer%d_done" % self._trace_step,
+                            time.time())
         self._release_handle_slot(handle)
         if cache_key is not None and self.cache is not None:
             # still zero-copy: the cached device array owns its bytes
@@ -737,6 +810,7 @@ class R2P1DLoader(StageModel):
             except Exception:
                 self._release_handle_slot(handle)
                 raise
+            self._stamp_decode_done(time_card)
             if handle.slot is not None:
                 # the follower pays its own transfer straight from the
                 # leader's slot rows (its own reference keeps them live)
@@ -752,6 +826,7 @@ class R2P1DLoader(StageModel):
             # this key consult the cache (success) or decode afresh
             if self._inflight_keys is not None:
                 self._inflight_keys.pop(handle.key)
+        self._stamp_decode_done(time_card)
         if handle.slot is not None:
             return self._materialize_slot(handle, time_card,
                                           cache_key=handle.key)
@@ -784,6 +859,7 @@ class R2P1DLoader(StageModel):
         clips = self._decode_sync(decoder, video, starts)
         n = clips.shape[0]
         time_card.num_clips = n
+        self._stamp_decode_done(time_card)
         if key is not None:
             time_card.cache_hit = False
         return self._materialize(clips, n, time_card, cache_key=key)
@@ -921,6 +997,16 @@ class R2P1DFusingLoader(R2P1DLoader):
             settings, self.row_buckets, self.max_clips)
         return self.autotune
 
+    def enable_trace(self, tracer, step_idx: int) -> None:
+        """On top of the base wiring (refinement stamps + staging
+        occupancy): sample this stage's decode window — decodes in
+        flight plus decoded-but-unemitted requests (deque len reads
+        are GIL-atomic, safe from the sampler thread)."""
+        super().enable_trace(tracer, step_idx)
+        tracer.add_counter_source(
+            trace.name("loader.s%d.inflight", step_idx),
+            lambda: len(self._inflight) + len(self._ready))
+
     def _harvest(self) -> None:
         """Move decode-complete requests from in-flight to ready,
         preserving FIFO order (a slow head occupies the whole pool
@@ -928,6 +1014,7 @@ class R2P1DFusingLoader(R2P1DLoader):
         while self._inflight and self._inflight[0].handle.ready:
             rec = self._inflight.popleft()
             rec.t_ready = time.monotonic()
+            trace.instant("loader.decode_ready", rid=rec.cards[0].id)
             self._ready.append(rec)
 
     def _drop_coalesce(self, rec: "_FuseRecord") -> None:
@@ -1079,6 +1166,12 @@ class R2P1DFusingLoader(R2P1DLoader):
         records were consumed (progress), False when nothing was
         takeable; a take whose every decode failed still returns True
         (the failures are on the take_failed() queue)."""
+        with trace.span("loader.emit"):
+            return self._emit_take()
+
+    def _emit_take(self) -> bool:
+        """:meth:`_emit` body (split out so the traced path can wrap
+        the whole take/assemble/handoff in one timeline span)."""
         cap = self.max_clips
         take, rows = [], 0
         while self._ready and len(take) < self.fuse:
@@ -1137,6 +1230,23 @@ class R2P1DFusingLoader(R2P1DLoader):
         # decide() budgets against slo_ms alongside the residual-fill
         # wait
         t_close = time.monotonic()
+        if self._trace_step is not None:
+            # phase-refinement stamps for every card shipping in this
+            # emission: its decode ended at the record's harvest
+            # instant (epoch-converted from the monotonic t_ready, and
+            # clamped so a follower swallowed after the decode reads a
+            # zero-length decode phase), and its hold ended NOW — the
+            # batch just closed and the transfer path begins
+            now_epoch = time.time()
+            now_mono = time.monotonic()
+            step = self._trace_step
+            for rec in ok:
+                decoded_at = now_epoch - max(0.0, now_mono - rec.t_ready)
+                for tc in rec.cards:
+                    _record_clamped(tc, "decode%d_done" % step,
+                                    decoded_at)
+                    _record_clamped(tc, "transfer%d_start" % step,
+                                    now_epoch)
         out, slot = self._assemble(ok, rows, bucket)
         if self.cache is not None:
             # insert-after-success: only decodes that reached this
@@ -1241,10 +1351,16 @@ class R2P1DFusingLoader(R2P1DLoader):
         confirmed lazily at the slot's next acquire, so the executor
         still never blocks on transfer completion."""
         jax, _ = _jax_numpy()
-        with hostprof.section("loader.device_put"):
+        with hostprof.section("loader.device_put"), \
+                trace.span("loader.transfer"):
             batch = jax.device_put(out, self._jax_device)
         if slot is not None:
             self.staging.finish_transfer(slot, batch)
+        if self._trace_step is not None:
+            at = time.time()
+            for tc in cards:
+                _record_clamped(tc, "transfer%d_done" % self._trace_step,
+                                at)
         if self._preprocess is not None:
             with hostprof.section("loader.preprocess_dispatch"):
                 batch = self._preprocess(batch)
@@ -1259,11 +1375,17 @@ class R2P1DFusingLoader(R2P1DLoader):
         confirm completion (alias-probed) before releasing the slot's
         transfer hold. Runs off the executor thread."""
         jax, _ = _jax_numpy()
-        with hostprof.section("transfer.device_put"):
+        with hostprof.section("transfer.device_put"), \
+                trace.span("loader.transfer"):
             batch = jax.device_put(out, self._jax_device)
         if slot is not None:
             with hostprof.section("transfer.confirm"):
                 self.staging.confirm_now(slot, batch)
+        if self._trace_step is not None:
+            at = time.time()
+            for tc in cards:
+                _record_clamped(tc, "transfer%d_done" % self._trace_step,
+                                at)
         if self._preprocess is not None:
             with hostprof.section("transfer.preprocess_dispatch"):
                 batch = self._preprocess(batch)
@@ -1702,6 +1824,12 @@ class R2P1DSingleStep(StageModel):
                                    "factored_shortcut", False),
                                pixel_path=kwargs.get("pixel_path",
                                                      "rgb"))
+
+    def enable_trace(self, tracer, step_idx: int) -> None:
+        """Forward to the embedded loader: its phase-refinement
+        stamps and occupancy sources apply to this fused step's
+        index (rnb_tpu.runner executor protocol)."""
+        self.loader.enable_trace(tracer, step_idx)
 
     def input_shape(self):
         return None

@@ -53,7 +53,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from rnb_tpu import hostprof
+from rnb_tpu import hostprof, trace
 from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
                              FaultStats, InferenceCounter, Signal,
                              TerminationFlag, TerminationState,
@@ -159,6 +159,13 @@ class RunnerContext:
     #: controller-owning stages append their final decision/deadline
     #: counters here (BenchmarkResult + log-meta `Autotune:` line)
     autotune_sink: Optional[List] = None
+    #: per-job rnb_tpu.trace.Tracer when the config's `trace` key
+    #: enabled tracing, else None. The executor emits hot-loop spans
+    #: through the module-level trace hooks (one None test when off),
+    #: calls model.enable_trace(tracer, step_idx) on stages that
+    #: refine phase stamps / register occupancy sources, and opts the
+    #: final-step summary into `# phases` trailers.
+    tracer: Optional[Any] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -311,6 +318,12 @@ def _drain_stage_failures(ctx: RunnerContext, take_failed, take_retries,
 def runner(ctx: RunnerContext) -> None:
     """Thread entry: init the stage, run the hot loop, drain cleanly."""
     summary = TimeCardSummary() if ctx.out_queues is None else None
+    if summary is not None and ctx.tracer is not None:
+        # trace-enabled runs opt the per-instance report into the
+        # `# phases` trailer (same steady-state skip as the job-wide
+        # Phases: line); trace-off reports stay byte-stable
+        summary.track_phases = True
+        summary.phase_num_skips = NUM_SUMMARY_SKIPS
     progress_bar = None
     declared_shapes = None
     controller = None
@@ -331,6 +344,12 @@ def runner(ctx: RunnerContext) -> None:
             # a bucket restriction it never warms is rejected here
             # (and statically by rnb-lint RNB-G006)
             controller = model.enable_autotune(ctx.autotune)
+        if ctx.tracer is not None and hasattr(model, "enable_trace"):
+            # unified tracing (rnb_tpu.trace): stages that refine the
+            # per-request phase stamps (decode/hold/transfer) and own
+            # sampled occupancy sources wire themselves up here; the
+            # executor's own spans need no stage support
+            model.enable_trace(ctx.tracer, ctx.step_idx)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
@@ -380,6 +399,15 @@ def runner(ctx: RunnerContext) -> None:
     # stamp sites)
     key_inf_start = "inference%d_start" % ctx.step_idx
     key_inf_finish = "inference%d_finish" % ctx.step_idx
+    # loop-invariant trace event names (rnb_tpu.trace): formatted once
+    # here so the hot loop's disabled path stays one None test with no
+    # allocation (the trace.name literals are what RNB-T008 checks)
+    tr_queue_get = trace.name("exec%d.queue_get", ctx.step_idx)
+    tr_hold_wait = trace.name("exec%d.hold_wait", ctx.step_idx)
+    tr_swallow = trace.name("exec%d.swallow", ctx.step_idx)
+    tr_model_call = trace.name("exec%d.model_call", ctx.step_idx)
+    tr_device_sync = trace.name("exec%d.device_sync", ctx.step_idx)
+    tr_publish = trace.name("exec%d.publish", ctx.step_idx)
 
     # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
     # first stage exposing submit()/complete() gets its next requests'
@@ -440,6 +468,8 @@ def runner(ctx: RunnerContext) -> None:
                         _sig, nt, tc = item
                         tc.add_device(ctx.device.label)
                         tc.record("runner%d_start" % ctx.step_idx)
+                        if ctx.tracer is not None:
+                            trace.instant(tr_swallow, rid=tc.id)
                         try:
                             pending.append((model.submit(nt, tc), nt, tc))
                         except Exception as exc:
@@ -463,7 +493,8 @@ def runner(ctx: RunnerContext) -> None:
                 else:
                     try:
                         if idle_poll is None:
-                            with hostprof.section(sec_queue_get):
+                            with hostprof.section(sec_queue_get), \
+                                    trace.span(tr_queue_get):
                                 item = ctx.in_queue.get(
                                     timeout=QUEUE_POLL_S)
                         else:
@@ -476,7 +507,9 @@ def runner(ctx: RunnerContext) -> None:
                             timeout, holding = poll_plan(model)
                             with hostprof.section(
                                     sec_hold_wait if holding
-                                    else sec_queue_get):
+                                    else sec_queue_get), \
+                                    trace.span(tr_hold_wait if holding
+                                               else tr_queue_get):
                                 item = ctx.in_queue.get(timeout=timeout)
                     except queue.Empty:
                         # idle tick: give accumulator stages (fusing
@@ -502,6 +535,12 @@ def runner(ctx: RunnerContext) -> None:
                         signal, non_tensors, time_card = item
                         time_card.add_device(ctx.device.label)
                         time_card.record("runner%d_start" % ctx.step_idx)
+                        if ctx.tracer is not None:
+                            # request-id flow anchors: one admitted
+                            # item may carry many cards (an upstream
+                            # fused batch)
+                            for _tc in _cards_of(time_card):
+                                trace.instant(tr_swallow, rid=_tc.id)
                         if controller is not None:
                             # arrival-rate estimator: the client's
                             # enqueue stamps (pure host arithmetic,
@@ -553,7 +592,10 @@ def runner(ctx: RunnerContext) -> None:
                             if ctx.fault_plan is not None:
                                 ctx.fault_plan.fire(ctx.step_idx, rids,
                                                     attempt)
-                            with hostprof.section(sec_model_call):
+                            with hostprof.section(sec_model_call), \
+                                    trace.span(tr_model_call,
+                                               getattr(in_card, "id",
+                                                       None)):
                                 if handle is not None and attempt == 0:
                                     tensors_out, non_tensors_out, \
                                         time_card = model.complete(
@@ -623,7 +665,8 @@ def runner(ctx: RunnerContext) -> None:
                                  "step %d %s" % (ctx.step_idx,
                                                  ctx.model_class_path))
                 if ctx.sync_outputs and tensors_out:
-                    with hostprof.section(sec_device_sync):
+                    with hostprof.section(sec_device_sync), \
+                            trace.span(tr_device_sync):
                         _block_on(tensors_out)
                 time_card.record("inference%d_finish" % ctx.step_idx)
                 if controller is not None and tensors_out \
@@ -681,7 +724,8 @@ def runner(ctx: RunnerContext) -> None:
                         continue
 
                 if ctx.output_ring is not None:
-                    with hostprof.section(sec_ring_publish):
+                    with hostprof.section(sec_ring_publish), \
+                            trace.span(tr_publish):
                         segments = split_segments(tensors_out,
                                                   ctx.num_segments)
                         for seg_idx, seg_payload in enumerate(segments):
@@ -724,7 +768,8 @@ def runner(ctx: RunnerContext) -> None:
                             break  # someone else already hit the target
                 else:
                     try:
-                        with hostprof.section(sec_enqueue):
+                        with hostprof.section(sec_enqueue), \
+                                trace.span(tr_publish):
                             for seg_idx in range(ctx.num_segments):
                                 forked = time_card.fork(seg_idx) \
                                     if ctx.num_segments > 1 else time_card

@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from rnb_tpu import trace
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 
 #: slot lifecycle states (kept as strings for cheap introspection)
@@ -190,7 +191,8 @@ class StagingPool:
         with self._lock:
             self.num_acquire_waits += 1
         from rnb_tpu import hostprof
-        with hostprof.section("staging.acquire_wait"):
+        with hostprof.section("staging.acquire_wait"), \
+                trace.span("staging.acquire_wait"):
             while True:
                 with self._available:
                     self.raise_if_failed_locked()
@@ -377,7 +379,8 @@ class TransferWorker:
                     return
                 job = self._jobs.popleft()
             try:
-                job()
+                with trace.span("transfer.job"):
+                    job()
             except BaseException as exc:  # noqa: BLE001 — surfaced
                 with self._wake:
                     if self._error is None:

@@ -97,6 +97,21 @@ STAMP_REGISTRY = (
               "model call (or prefetched-decode completion) began"),
     StampSpec("inference{step}_finish", "rnb_tpu/runner.py",
               "stage output ready (device-synced unless async_dispatch)"),
+    # -- phase-refinement stamps (rnb_tpu.trace): recorded ONLY when
+    # the job's `trace` config key enables tracing, so trace-off runs
+    # stay byte-stable with the pre-trace schema. They split the
+    # loader's inference{step} span into decode/hold/transfer/drain
+    # for per-request attribution (parse_utils --attribute).
+    StampSpec("decode{step}_done", "rnb_tpu/models/r2p1d/model.py",
+              "this request's clip decode completed (trace mode only; "
+              "a cache hit records a zero-length decode phase)"),
+    StampSpec("transfer{step}_start", "rnb_tpu/models/r2p1d/model.py",
+              "the emission holding this request closed and its "
+              "host->device transfer began (trace mode only)"),
+    StampSpec("transfer{step}_done", "rnb_tpu/models/r2p1d/model.py",
+              "host->device transfer dispatched/confirmed; the gap to "
+              "inference{step}_finish is publish drain (trace mode "
+              "only)"),
 )
 
 #: every ``<Prefix>:``-keyed line rnb_tpu/benchmark.py may write into
@@ -126,6 +141,14 @@ META_LINE_REGISTRY = (
     StampSpec("Autotune buckets:", "rnb_tpu/benchmark.py",
               "JSON per-chosen-bucket emission counts "
               "(autotune-enabled runs only)"),
+    StampSpec("Trace:", "rnb_tpu/benchmark.py",
+              "trace-export counters: events written to trace.json, "
+              "events dropped at the max_events cap "
+              "(trace-enabled runs only)"),
+    StampSpec("Phases:", "rnb_tpu/benchmark.py",
+              "JSON per-phase latency attribution "
+              "{phase: {mean_ms, p99_ms, count}} over steady-state "
+              "completions (trace-enabled runs only)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry
@@ -135,6 +158,77 @@ TABLE_TRAILER_REGISTRY = (
               "per-instance failed/shed/retry counts + reasons"),
     StampSpec("cache", "rnb_tpu/telemetry.py",
               "per-instance completed-request cache attribution"),
+    StampSpec("phases", "rnb_tpu/telemetry.py",
+              "per-instance per-phase latency attribution "
+              "(mean/p99 microseconds; trace-enabled runs only)"),
+)
+
+
+#: every span/instant/counter name the tracing layer (rnb_tpu.trace)
+#: may emit into logs/<job>/trace.json — ``{step}`` stands for the
+#: pipeline-step or queue index, formatted at the ``trace.name`` call
+#: site. The static schema checker (rnb_tpu.analysis.schema,
+#: RNB-T008) cross-checks these declarations against the actual
+#: instrumentation sites, so a trace event can neither appear
+#: unregistered nor linger registered after its site is deleted.
+TRACE_EVENT_REGISTRY = (
+    StampSpec("client.enqueue", "rnb_tpu/client.py",
+              "instant: client created + enqueued one request (flow "
+              "anchor for the request id)"),
+    StampSpec("client.enqueued", "rnb_tpu/client.py",
+              "counter: cumulative requests the client has emitted"),
+    StampSpec("client.shed", "rnb_tpu/client.py",
+              "instant: client dropped a request at the full filename "
+              "queue (overload_policy shed)"),
+    StampSpec("exec{step}.queue_get", "rnb_tpu/runner.py",
+              "span: executor blocked on its input queue (starvation)"),
+    StampSpec("exec{step}.hold_wait", "rnb_tpu/runner.py",
+              "span: executor blocked while its stage holds work "
+              "(batch-fill wait, not starvation)"),
+    StampSpec("exec{step}.swallow", "rnb_tpu/runner.py",
+              "instant: one request admitted into the stage"),
+    StampSpec("exec{step}.model_call", "rnb_tpu/runner.py",
+              "span: the stage model call for one dispatch"),
+    StampSpec("exec{step}.device_sync", "rnb_tpu/runner.py",
+              "span: blocking on device output readiness "
+              "(sync_outputs)"),
+    StampSpec("exec{step}.publish", "rnb_tpu/runner.py",
+              "span: route + ring write + downstream enqueue"),
+    StampSpec("loader.decode_submit", "rnb_tpu/models/r2p1d/model.py",
+              "instant: one request's decode submitted to the pool"),
+    StampSpec("loader.decode", "rnb_tpu/models/r2p1d/model.py",
+              "span: fallback-pool decode body (rnb-decode threads; "
+              "native-pool decodes run in C++ and are delimited by "
+              "the submit/ready instants instead)"),
+    StampSpec("loader.decode_ready", "rnb_tpu/models/r2p1d/model.py",
+              "instant: one request's decode observed complete"),
+    StampSpec("loader.emit", "rnb_tpu/models/r2p1d/model.py",
+              "span: fused-batch take/assemble/handoff"),
+    StampSpec("loader.transfer", "rnb_tpu/models/r2p1d/model.py",
+              "span: host->device device_put (+ confirm/preprocess "
+              "dispatch) — executor thread or transfer worker"),
+    StampSpec("loader.s{step}.inflight", "rnb_tpu/models/r2p1d/model.py",
+              "counter (sampled): decodes in flight + decoded-but-"
+              "unemitted requests held by the loader"),
+    StampSpec("staging.s{step}.free", "rnb_tpu/models/r2p1d/model.py",
+              "counter (sampled): free staging slots in the loader's "
+              "pool"),
+    StampSpec("staging.acquire_wait", "rnb_tpu/staging.py",
+              "span: blocked acquiring a staging slot (exhaustion "
+              "backpressure)"),
+    StampSpec("transfer.job", "rnb_tpu/staging.py",
+              "span: one queued job on the transfer worker thread"),
+    StampSpec("batcher.emit", "rnb_tpu/batcher.py",
+              "instant: the Batcher fused + emitted one batch "
+              "(args: requests, rows)"),
+    StampSpec("autotune.decision", "rnb_tpu/autotune.py",
+              "instant: one BatchController decision (args: verdict, "
+              "target_rows, hold_ms)"),
+    StampSpec("queue.filename.depth", "rnb_tpu/benchmark.py",
+              "counter (sampled): client filename queue depth"),
+    StampSpec("queue.e{step}.depth", "rnb_tpu/benchmark.py",
+              "counter (sampled): inter-stage queue depth, keyed by "
+              "queue index"),
 )
 
 
@@ -343,6 +437,12 @@ class TimeCardSummary:
         self.num_cache_hits: int = 0
         self.num_cache_coalesced: int = 0
         self.num_cache_tracked: int = 0
+        # per-request phase attribution (rnb_tpu.trace): surfaced as a
+        # `# phases` trailer + the job-wide `Phases:` line ONLY when
+        # the executor opts this summary in (trace-enabled runs) —
+        # trace-off reports stay byte-stable with the earlier schema
+        self.track_phases: bool = False
+        self.phase_num_skips: int = 0
 
     def note_failure(self, reason: str, n: int = 1) -> None:
         """Count a contained permanent failure (excluded from timings)."""
@@ -463,6 +563,43 @@ class TimeCardSummary:
                 % (self.num_cache_hits, self.num_cache_coalesced,
                    self.num_cache_tracked))
 
+    def phase_samples(self, num_skips: int = 0):
+        """{phase: [per-request milliseconds]} over records after
+        ``num_skips`` — the deterministic stamp-only decomposition
+        (rnb_tpu.trace.attribute_phases) applied to this instance's
+        columnar data. Phases partition each request's end-to-end
+        span, so per-request sums equal latencies_ms() exactly."""
+        from rnb_tpu.trace import attribute_phases
+        samples: "OrderedDict[str, List[float]]" = OrderedDict()
+        if not self.keys or len(self.keys) < 2:
+            return samples
+        columns = [self.summary[key][num_skips:] for key in self.keys]
+        for row in zip(*columns):
+            for phase, ms in attribute_phases(
+                    dict(zip(self.keys, row))).items():
+                samples.setdefault(phase, []).append(ms)
+        return samples
+
+    def phases_line(self) -> Optional[str]:
+        """The ``# phases ...`` trailer, or None when phase tracking
+        is off (trace-disabled runs keep the earlier byte-stable
+        schema) or too few records exist. Microsecond integers so the
+        generic ``key=value`` trailer parser reads it unchanged."""
+        if not self.track_phases:
+            return None
+        from rnb_tpu.trace import phase_stats, sorted_phases
+        stats = phase_stats(self.phase_samples(self.phase_num_skips))
+        if not stats:
+            return None
+        count = max(s["count"] for s in stats.values())
+        parts = ["# phases n=%d" % count]
+        for phase in sorted_phases(stats):
+            parts.append("%s_mean_us=%d"
+                         % (phase, round(stats[phase]["mean_ms"] * 1000)))
+            parts.append("%s_p99_us=%d"
+                         % (phase, round(stats[phase]["p99_ms"] * 1000)))
+        return " ".join(parts)
+
     def save_full_report(self, fp: IO[str]) -> None:
         # Per-step device-column widths can differ across records (a merge
         # collapses segments that happened to share a device); size each
@@ -499,3 +636,6 @@ class TimeCardSummary:
         cache = self.cache_line()
         if cache is not None:
             fp.write(cache + "\n")
+        phases = self.phases_line()
+        if phases is not None:
+            fp.write(phases + "\n")

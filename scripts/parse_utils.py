@@ -88,6 +88,20 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["autotune_" + key] = int(val)
+        elif line.startswith("Trace:"):
+            # "Trace: events=N dropped=M" — written only by
+            # trace-enabled runs (rnb_tpu.trace); counts events
+            # exported to logs/<job>/trace.json and events dropped at
+            # the max_events cap
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["trace_" + key] = int(val)
+        elif line.startswith("Phases:"):
+            # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
+            # latency attribution over steady-state completions,
+            # written only by trace-enabled runs (rnb_tpu.trace)
+            import json
+            meta["phases"] = json.loads(line.split(":", 1)[1])
         elif line.startswith("Failure reasons:"):
             import json
             meta["failure_reasons"] = json.loads(line.split(":", 1)[1])
@@ -257,6 +271,18 @@ STANDARD_COMPONENTS = [
     ("inference1_start", "inference1_finish", "neural_net"),
 ]
 
+#: trace-mode refinement of the loader span (rnb_tpu.trace): runs with
+#: the `trace` config key enabled additionally stamp decode{step}_done
+#: / transfer{step}_start / transfer{step}_done, splitting the step-0
+#: "decode" component into decode / hold / transfer / drain. Absent
+#: columns are simply skipped, so pre-trace logs decompose unchanged.
+REFINED_COMPONENTS = [
+    ("inference0_start", "decode0_done", "decode_only"),
+    ("decode0_done", "transfer0_start", "batch_hold"),
+    ("transfer0_start", "transfer0_done", "transfer"),
+    ("transfer0_done", "inference0_finish", "publish_drain"),
+]
+
 
 def dispatch_batch_sizes(df: pd.DataFrame,
                          step: Optional[int] = None) -> pd.Series:
@@ -313,7 +339,7 @@ def decompose_latency(df: pd.DataFrame) -> pd.DataFrame:
                  and c not in ("final_group", "final_instance")]
     named = set()
     out = df.copy()
-    for prv, nxt, name in STANDARD_COMPONENTS:
+    for prv, nxt, name in STANDARD_COMPONENTS + REFINED_COMPONENTS:
         if prv in time_cols and nxt in time_cols:
             out[name] = (df[nxt] - df[prv]) * 1000.0
             named.update((prv, nxt))
@@ -322,6 +348,128 @@ def decompose_latency(df: pd.DataFrame) -> pd.DataFrame:
             continue
         out["gap:%s->%s" % (prv, nxt)] = (df[nxt] - df[prv]) * 1000.0
     return out
+
+
+# -- per-request phase attribution (CLI: --attribute <job_dir>) --------
+
+def _rnb_trace():
+    """Import :mod:`rnb_tpu.trace` (the attribution rules live next to
+    the tracer so the online ``Phases:`` line and this offline path can
+    never diverge) from the repo checkout this script sits in."""
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from rnb_tpu import trace
+    return trace
+
+
+def _summary_skips() -> int:
+    """The per-instance warm-record skip the job-wide summaries apply
+    (rnb_tpu.runner.NUM_SUMMARY_SKIPS)."""
+    _rnb_trace()
+    from rnb_tpu.runner import NUM_SUMMARY_SKIPS
+    return NUM_SUMMARY_SKIPS
+
+
+#: columns of a timing table that are identity, not timestamps
+_NON_TIME_COLS = ("final_device", "final_group", "final_instance")
+
+
+def _table_time_cols(df: pd.DataFrame) -> List[str]:
+    return [c for c in df.columns
+            if not c.startswith("device") and c not in _NON_TIME_COLS]
+
+
+def _df_phase_rows(df: pd.DataFrame, num_skips: int = 0):
+    """Yield ``(phases, e2e_ms)`` per row after ``num_skips`` — the
+    single-pass primitive under ``--attribute``/``--check``: each row's
+    stamp-only decomposition (rnb_tpu.trace.attribute_phases) together
+    with its end-to-end latency, so samples and the partition residual
+    come out of one iteration. Rows with fewer than two recorded
+    stamps (nothing to decompose) are skipped."""
+    trace = _rnb_trace()
+    time_cols = _table_time_cols(df)
+    for row in df.iloc[num_skips:][time_cols].itertuples(index=False):
+        timings = {k: t for k, t in zip(time_cols, row) if t == t}
+        if len(timings) < 2:
+            continue
+        e2e_ms = (max(timings.values()) - min(timings.values())) * 1e3
+        yield trace.attribute_phases(timings), e2e_ms
+
+
+def table_phase_samples(path: str, num_skips: int = 0
+                        ) -> Dict[str, List[float]]:
+    """{phase: [per-request milliseconds]} over one timing table's
+    rows after ``num_skips`` — the deterministic stamp-only
+    decomposition (rnb_tpu.trace.attribute_phases), so it works on any
+    past log: without the trace-mode refinement stamps
+    (decode0_done / transfer0_start / transfer0_done) the whole loader
+    span reports as one ``decode`` phase."""
+    samples: Dict[str, List[float]] = {}
+    for phases, _e2e_ms in _df_phase_rows(parse_timing_table(path),
+                                          num_skips):
+        for phase, ms in phases.items():
+            samples.setdefault(phase, []).append(ms)
+    return samples
+
+
+def attribute_job(job_dir: str, num_skips: Optional[int] = None
+                  ) -> Dict[str, Dict[str, float]]:
+    """Job-wide per-phase attribution {phase: {mean_ms, p99_ms,
+    count}} over every final instance's steady-state rows — the same
+    aggregation rule as the log-meta ``Phases:`` line, recomputed from
+    the tables alone. ``num_skips`` defaults to the summary convention
+    (rnb_tpu.runner.NUM_SUMMARY_SKIPS per instance)."""
+    trace = _rnb_trace()
+    if num_skips is None:
+        num_skips = _summary_skips()
+    merged: Dict[str, List[float]] = {}
+    for path in _timing_tables(job_dir):
+        for phase, vals in table_phase_samples(path, num_skips).items():
+            merged.setdefault(phase, []).extend(vals)
+    return trace.phase_stats(merged)
+
+
+def print_attribution(job_dir: str, out=None) -> int:
+    """``--attribute``: print the per-phase mean/p99 table for one job
+    and verify the partition invariant (phases sum to each request's
+    end-to-end latency). Returns 0 on success, 1 when the invariant
+    fails or the job has no rows."""
+    import sys as _sys
+    trace = _rnb_trace()
+    out = out or _sys.stdout
+    # one pass over the tables: phase samples and the partition
+    # residual (1 ms tolerance, same bound --check applies) come from
+    # the same parsed rows
+    merged: Dict[str, List[float]] = {}
+    worst = 0.0
+    latencies: List[float] = []
+    num_skips = _summary_skips()
+    for path in _timing_tables(job_dir):
+        df = parse_timing_table(path)
+        for phases, e2e_ms in _df_phase_rows(df, num_skips):
+            for phase, ms in phases.items():
+                merged.setdefault(phase, []).append(ms)
+            worst = max(worst, abs(sum(phases.values()) - e2e_ms))
+            latencies.append(e2e_ms)
+    stats = trace.phase_stats(merged)
+    if not stats:
+        out.write("%s: no steady-state rows to attribute\n" % job_dir)
+        return 1
+    out.write("%s: per-request phase attribution "
+              "(steady-state, mean/p99 ms)\n" % job_dir)
+    mean_sum = 0.0
+    for phase in trace.sorted_phases(stats):
+        s = stats[phase]
+        mean_sum += s["mean_ms"]
+        out.write("  %-18s %9.3f / %9.3f  (n=%d)\n"
+                  % (phase, s["mean_ms"], s["p99_ms"], s["count"]))
+    mean_e2e = sum(latencies) / len(latencies) if latencies else 0.0
+    out.write("  %-18s %9.3f  (end-to-end mean %0.3f, worst "
+              "per-request residual %.6f ms)\n"
+              % ("sum", mean_sum, mean_e2e, worst))
+    return 0 if worst <= 1.0 else 1
 
 
 # -- consistency checking (CLI: parse_utils.py --check <job_dir>) ------
@@ -493,6 +641,143 @@ def check_job(job_dir: str) -> List[str]:
                     "warms (configured: %s) — each would have been a "
                     "silent mid-run recompile"
                     % (rogue, sorted(configured)))
+
+    # phase attribution (rnb_tpu.trace): the stamp-only decomposition
+    # must partition every request's end-to-end span, cover every
+    # steady row once per phase, and agree across its three surfaced
+    # forms (per-instance '# phases' trailers, the job-wide 'Phases:'
+    # line, a recomputation from the raw tables)
+    problems.extend(_check_phases(job_dir, meta, tables))
+    # trace export accounting: the Trace: line must match what
+    # trace.json actually holds, and the artifact must be structurally
+    # valid (every event stamped, every flow resolving)
+    problems.extend(_check_trace_artifact(job_dir, meta))
+    return problems
+
+
+def _check_phases(job_dir: str, meta: Dict[str, object],
+                  tables: List[str]) -> List[str]:
+    problems: List[str] = []
+    try:
+        trace = _rnb_trace()
+        num_skips = _summary_skips()
+    except Exception as e:  # noqa: BLE001 — surfaced, not hidden
+        return ["phase check unavailable (rnb_tpu unimportable): %s" % e]
+    merged: Dict[str, List[float]] = {}
+    saw_phase_trailer = False
+    for path in tables:
+        base = os.path.basename(path)
+        try:
+            df = parse_timing_table(path)
+        except (OSError, ValueError):
+            continue  # already reported by the table loop above
+        # partition invariant over EVERY row (warm records included):
+        # per-request phases must sum to the end-to-end latency
+        for phases, e2e_ms in _df_phase_rows(df):
+            total = sum(phases.values())
+            if abs(total - e2e_ms) > 1.0:
+                problems.append(
+                    "%s: a request's phases sum to %.3f ms but its "
+                    "end-to-end latency is %.3f ms (attribution must "
+                    "partition the span)" % (base, total, e2e_ms))
+                break  # one report per table is enough
+        samples: Dict[str, List[float]] = {}
+        for phases, _e2e_ms in _df_phase_rows(df, num_skips):
+            for phase, ms in phases.items():
+                samples.setdefault(phase, []).append(ms)
+        steady = max(0, len(df) - num_skips)
+        if samples:
+            counts = {len(vals) for vals in samples.values()}
+            if counts != {steady}:
+                problems.append(
+                    "%s: phase sample counts %s != steady row count %d "
+                    "(every completed request contributes exactly one "
+                    "sample per phase)"
+                    % (base, sorted(counts), steady))
+            for phase, vals in samples.items():
+                merged.setdefault(phase, []).extend(vals)
+        trailer = parse_table_trailers(path).get("phases")
+        if trailer is not None:
+            saw_phase_trailer = True
+            stats = trace.phase_stats(samples)
+            n = max((s["count"] for s in stats.values()), default=0)
+            if trailer.get("n") != n:
+                problems.append(
+                    "%s: '# phases' trailer says n=%s but the table "
+                    "holds %d steady rows" % (base, trailer.get("n"),
+                                              n))
+            for phase, s in sorted(stats.items()):
+                for stat_key, fmt in (("mean_ms", "%s_mean_us"),
+                                      ("p99_ms", "%s_p99_us")):
+                    want = round(s[stat_key] * 1000)
+                    got = trailer.get(fmt % phase)
+                    if got is None or abs(got - want) > 1:
+                        problems.append(
+                            "%s: '# phases' trailer %s=%s but the "
+                            "table's rows recompute to %d"
+                            % (base, fmt % phase, got, want))
+    if "phases" in meta:
+        if not saw_phase_trailer and tables:
+            problems.append("log-meta carries a 'Phases:' line but no "
+                            "table carries a '# phases' trailer")
+        stats = trace.phase_stats(merged)
+        line = meta["phases"]
+        if set(line) != set(stats):
+            problems.append(
+                "'Phases:' line names phases %s but the tables "
+                "recompute %s" % (sorted(line), sorted(stats)))
+        else:
+            for phase, s in sorted(stats.items()):
+                if line[phase].get("count") != s["count"]:
+                    problems.append(
+                        "'Phases:' %s count=%s but tables hold %d "
+                        "steady samples" % (phase,
+                                            line[phase].get("count"),
+                                            s["count"]))
+                for stat_key in ("mean_ms", "p99_ms"):
+                    got = line[phase].get(stat_key)
+                    if got is None or abs(got - s[stat_key]) > 0.005:
+                        problems.append(
+                            "'Phases:' %s %s=%s but tables recompute "
+                            "%.6f" % (phase, stat_key, got,
+                                      s[stat_key]))
+    elif saw_phase_trailer:
+        problems.append("tables carry a '# phases' trailer but "
+                        "log-meta has no 'Phases:' line")
+    return problems
+
+
+def _check_trace_artifact(job_dir: str,
+                          meta: Dict[str, object]) -> List[str]:
+    problems: List[str] = []
+    path = os.path.join(job_dir, "trace.json")
+    if "trace_events" in meta:
+        if not os.path.isfile(path):
+            return ["log-meta carries a 'Trace:' line but trace.json "
+                    "is missing"]
+        trace = _rnb_trace()
+        import json
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError as e:
+            return ["trace.json unreadable: %s" % e]
+        recorded = doc.get("otherData", {}).get("num_events")
+        if recorded != meta["trace_events"]:
+            problems.append(
+                "'Trace:' line says events=%s but trace.json records "
+                "num_events=%s" % (meta["trace_events"], recorded))
+        dropped = doc.get("otherData", {}).get("dropped_events")
+        if dropped != meta.get("trace_dropped"):
+            problems.append(
+                "'Trace:' line says dropped=%s but trace.json records "
+                "dropped_events=%s" % (meta.get("trace_dropped"),
+                                       dropped))
+        for issue in trace.validate_trace(path)[:5]:
+            problems.append("trace.json: %s" % issue)
+    elif os.path.isfile(path):
+        problems.append("trace.json present but log-meta has no "
+                        "'Trace:' line")
     return problems
 
 
@@ -552,7 +837,8 @@ def print_stamp_registry(out=None) -> None:
     if repo not in _sys.path:
         _sys.path.insert(0, repo)
     from rnb_tpu.telemetry import (META_LINE_REGISTRY, STAMP_REGISTRY,
-                                   TABLE_TRAILER_REGISTRY, CONTENT_STAMPS)
+                                   TABLE_TRAILER_REGISTRY,
+                                   TRACE_EVENT_REGISTRY, CONTENT_STAMPS)
     out.write("# Telemetry schema reference (generated by "
               "parse_utils.py --stamps)\n")
     out.write("# Source of truth: rnb_tpu/telemetry.py registries; "
@@ -575,6 +861,11 @@ def print_stamp_registry(out=None) -> None:
     for spec in TABLE_TRAILER_REGISTRY:
         out.write("%-26s %-22s %s\n" % (spec.pattern, spec.producer,
                                         spec.description))
+    out.write("\n## Trace events (logs/<job>/trace.json, trace-enabled "
+              "runs only;\n## {step} = pipeline-step or queue index)\n")
+    for spec in TRACE_EVENT_REGISTRY:
+        out.write("%-26s %-22s %s\n" % (spec.pattern, spec.producer,
+                                        spec.description))
 
 
 def main(argv=None) -> int:
@@ -589,6 +880,11 @@ def main(argv=None) -> int:
     parser.add_argument("--stamps", action="store_true",
                         help="print the generated telemetry-schema "
                              "reference (stamp registry) and exit")
+    parser.add_argument("--attribute", action="store_true",
+                        help="per-request phase attribution: print the "
+                             "per-phase mean/p99 table derived from "
+                             "TimeCard stamps alone and verify phases "
+                             "sum to end-to-end latency")
     args = parser.parse_args(argv)
     if args.stamps:
         print_stamp_registry()
@@ -597,6 +893,9 @@ def main(argv=None) -> int:
         parser.error("job_dirs required unless --stamps is given")
     status = 0
     for job_dir in args.job_dirs:
+        # --attribute and --check compose: both run, worst status wins
+        if args.attribute:
+            status = max(status, print_attribution(job_dir))
         if args.check:
             problems = check_job(job_dir)
             if problems:
@@ -606,7 +905,7 @@ def main(argv=None) -> int:
                     print("  - %s" % problem)
             else:
                 print("%s: OK" % job_dir)
-        else:
+        if not args.attribute and not args.check:
             meta, df = get_data(job_dir)
             print("%s: %d requests" % (job_dir, len(df)))
             for key in sorted(meta):

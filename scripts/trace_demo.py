@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""``make trace``: run a tiny traced pipeline end-to-end and validate
+the exported Chrome trace.
+
+Drives the test-suite's lightweight stages (tests.pipeline_helpers)
+through ``run_benchmark`` with the root ``trace`` config key enabled —
+no dataset, no native decoder, a few seconds on the 8-virtual-device
+CPU backend — then structurally validates ``trace.json``
+(rnb_tpu.trace.validate_trace), prints the named tracks, runs the
+``parse_utils --check`` invariants, and prints the per-request phase
+attribution. Exit 0 = everything holds; the job directory (printed)
+is ready to drop into https://ui.perfetto.dev.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CONFIG = {
+    "_comment": "make-trace demo: tiny 2-stage pipeline, tracing on",
+    "video_path_iterator":
+        "tests.pipeline_helpers.CountingPathIterator",
+    "trace": {"enabled": True, "sample_hz": 100, "max_events": 100000},
+    "pipeline": [
+        {"model": "tests.pipeline_helpers.TinyLoader",
+         "queue_groups": [{"devices": [0], "out_queues": [0]}],
+         "num_shared_tensors": 4},
+        {"model": "tests.pipeline_helpers.TinySink",
+         "queue_groups": [{"devices": [1], "in_queue": 0}]},
+    ],
+}
+
+
+def main() -> int:
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.trace import track_names, validate_trace
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    with tempfile.TemporaryDirectory(prefix="rnb-trace-cfg-") as tmp:
+        cfg_path = os.path.join(tmp, "trace-demo.json")
+        with open(cfg_path, "w") as f:
+            json.dump(CONFIG, f)
+        res = run_benchmark(cfg_path, mean_interval_ms=1,
+                            num_videos=40, queue_size=50,
+                            log_base=os.path.join(REPO, "logs"),
+                            print_progress=False)
+    if res.termination_flag != 0:
+        print("FAIL: run terminated with flag %d" % res.termination_flag)
+        return 1
+    trace_path = os.path.join(res.log_dir, "trace.json")
+    problems = validate_trace(trace_path)
+    for problem in problems:
+        print("FAIL: %s" % problem)
+    tracks = track_names(trace_path)
+    print("trace: %d event(s), %d dropped -> %s"
+          % (res.trace_events, res.trace_dropped, trace_path))
+    print("tracks: %s" % ", ".join(tracks))
+    check = parse_utils.check_job(res.log_dir)
+    for problem in check:
+        print("FAIL: --check: %s" % problem)
+    status = parse_utils.print_attribution(res.log_dir)
+    if problems or check or status:
+        return 1
+    print("OK — open %s at https://ui.perfetto.dev" % trace_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

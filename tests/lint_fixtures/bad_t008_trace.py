@@ -1,0 +1,12 @@
+"""RNB-T008: emits an unregistered trace event name (plus the
+registered ones, so no dead-registry finding muddies the fixture)."""
+
+from rnb_tpu import trace
+
+
+def emit(step, value):
+    trace.instant("good.event")
+    trace.counter("good.gauge", value)
+    with trace.span(trace.name("good.e%d.depth", step)):
+        pass
+    trace.instant("mystery.event")

@@ -1,0 +1,12 @@
+"""Schema fixture: emits exactly the (test-local) registered trace
+event names through every rnb_tpu.trace entry-point shape the
+extractor must see."""
+
+from rnb_tpu import trace
+
+
+def emit(step, value):
+    trace.instant("good.event")
+    trace.counter("good.gauge", value)
+    with trace.span(trace.name("good.e%d.depth", step)):
+        pass

@@ -6,3 +6,6 @@ def stamp_all(tc, step):
     tc.record("runner%d_start" % step)
     tc.record("inference%d_start" % step)
     tc.record("inference%d_finish" % step)
+    tc.record("decode%d_done" % step)
+    tc.record("transfer%d_start" % step)
+    tc.record("transfer%d_done" % step)

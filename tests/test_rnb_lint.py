@@ -131,6 +131,53 @@ def test_unregistered_content_stamp_triggers_t007():
         == {("RNB-T007", "mystery_attr")}
 
 
+def test_trace_event_fixture_is_clean():
+    from rnb_tpu.analysis.schema import check_trace_events
+    from rnb_tpu.telemetry import StampSpec
+    registry = (StampSpec("good.event", "f", "instant"),
+                StampSpec("good.gauge", "f", "counter"),
+                StampSpec("good.e{step}.depth", "f", "span via name"))
+    findings = check_trace_events([_fixture("good_t008_trace.py")],
+                                  root=FIXTURES, registry=registry)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_unregistered_trace_event_triggers_t008():
+    from rnb_tpu.analysis.schema import check_trace_events
+    from rnb_tpu.telemetry import StampSpec
+    registry = (StampSpec("good.event", "f", "instant"),
+                StampSpec("good.gauge", "f", "counter"),
+                StampSpec("good.e{step}.depth", "f", "span via name"))
+    findings = check_trace_events([_fixture("bad_t008_trace.py")],
+                                  root=FIXTURES, registry=registry)
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T008", "mystery.event")}
+
+
+def test_dead_trace_registry_entry():
+    # a registered trace event no site emits is an RNB-T003 dead entry
+    from rnb_tpu.analysis.schema import check_trace_events
+    from rnb_tpu.telemetry import StampSpec
+    registry = (StampSpec("good.event", "f", "instant"),
+                StampSpec("good.gauge", "f", "counter"),
+                StampSpec("good.e{step}.depth", "f", "span via name"),
+                StampSpec("ghost.event", "nowhere", "never emitted"))
+    findings = check_trace_events([_fixture("good_t008_trace.py")],
+                                  root=FIXTURES, registry=registry)
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T003", "ghost.event")}
+
+
+def test_repo_trace_events_all_registered():
+    # the real tree: every emitted trace event name is declared and
+    # every declared name is still emitted somewhere
+    from rnb_tpu.analysis.findings import package_py_files
+    from rnb_tpu.analysis.schema import check_trace_events
+    findings = check_trace_events(
+        package_py_files(os.path.join(REPO, "rnb_tpu")), root=REPO)
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_dead_and_unparsed_registry_stamp(tmp_path):
     # a registered stamp nothing records and parse_utils never read:
     # both directions of the cross-check fire
@@ -158,6 +205,8 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Staging: slots=%d\\n" % s)\n'
                      'f.write("Autotune: decisions=%d\\n" % d)\n'
                      'f.write("Autotune buckets: %s\\n" % b)\n'
+                     'f.write("Trace: events=%d\\n" % t)\n'
+                     'f.write("Phases: %s\\n" % p)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -190,7 +239,8 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'reallocs=%d\\n" % z)\n'
         'f.write("Autotune: decisions=%d immediate=%d held=%d '
         'emissions=%d deadline_us_min=%d deadline_us_max=%d '
-        'deadline_us_sum=%d\\n" % w)\n')
+        'deadline_us_sum=%d\\n" % w)\n'
+        'f.write("Trace: events=%d dropped=%d\\n" % v)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}
