@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace
+.PHONY: lint test native stamps trace ragged
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -27,6 +27,13 @@ stamps:
 # ready for ui.perfetto.dev and prints the phase attribution.
 trace:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/trace_demo.py
+
+# Tiny ragged-dispatch A/B end-to-end (README "Ragged dispatch"):
+# bucketed vs same-seed ragged arm, asserting one compiled shape,
+# zero computed pad rows, pad_rows_eliminated == the bucketed arm's
+# pad_rows, and parse_utils --check green on both.
+ragged:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/ragged_demo.py
 
 native:
 	$(MAKE) -C native

@@ -43,6 +43,20 @@ Rules
 * ``RNB-G008`` dtype-mismatch: producer output dtype and consumer
   input dtype are both declared and differ (e.g. a yuv420 loader wired
   into an rgb network stage).
+* ``RNB-G009`` ragged-pool-mismatch: the root ``ragged`` key's
+  ``pool_rows`` does not equal a participating stage's declared max
+  row axis — the pool is the stage's ONE compiled shape, so a
+  different capacity would silently change every declared wire shape
+  and warmup signature (the stage constructor rejects it at launch;
+  this rule rejects it statically).
+
+Ragged interplay: with the root ``ragged`` key enabled, participating
+stages ship exactly one shape (the pool) with a traced ``rows_valid``
+scalar, so the RNB-G006 bucket-coverage check and the
+``autotune.buckets`` warmed-subset check relax — any row count up to
+the pool capacity is dispatchable without a recompile, and configured
+``row_buckets`` are only the counterfactual pad rule the
+``pad_rows_eliminated`` counter is measured against.
 """
 
 from __future__ import annotations
@@ -197,10 +211,48 @@ def check_config(path: str, root: str = ".") -> List[Finding]:
                         "of %s — the open kwargs passthrough would "
                         "silently drop it" % (key, cls.__name__)))
 
+    # ragged row-pool dispatch (root 'ragged' key,
+    # rnb_tpu.ops.ragged): an explicit pool_rows must equal every
+    # participating stage's declared max row axis — the same invariant
+    # resolve_pool_rows enforces at construction, checked statically
+    ragged_cfg = config.ragged
+    ragged_on = ragged_cfg is not None and ragged_cfg.get("enabled",
+                                                          True)
+    if ragged_on and ragged_cfg.get("pool_rows") is not None:
+        pool_rows = int(ragged_cfg["pool_rows"])
+        for step_idx, (step, cls) in enumerate(zip(config.steps,
+                                                   classes)):
+            if cls is None or not getattr(cls, "SUPPORTS_RAGGED",
+                                          False):
+                continue
+            for group_idx, group in enumerate(step.groups):
+                anchor = "step%d.group%d.ragged" % (step_idx,
+                                                    group_idx)
+                kwargs = step.kwargs_for_group(group_idx)
+                shapes = _declared(cls, "output_shape_for", kwargs,
+                                   rel, anchor, findings)
+                if shapes is None:
+                    # final-style stages declare via input_shape_for
+                    shapes = _declared(cls, "input_shape_for", kwargs,
+                                       rel, anchor, findings)
+                if not shapes:
+                    continue
+                declared_max = int(tuple(shapes[0])[0])
+                if pool_rows != declared_max:
+                    findings.append(Finding(
+                        "RNB-G009", rel, 0, anchor,
+                        "'ragged.pool_rows'=%d does not match %s's "
+                        "declared max row axis %d — the pool is the "
+                        "stage's one compiled shape, so its capacity "
+                        "must equal the declared max"
+                        % (pool_rows, cls.__name__, declared_max)))
+
     # load-adaptive batching (root 'autotune' key, rnb_tpu.autotune):
     # an autotune.buckets restriction must stay inside each
     # participating stage's warmed bucket set — the same invariant
-    # BatchController.for_stage enforces at launch, checked statically
+    # BatchController.for_stage enforces at launch, checked statically.
+    # Under ragged dispatch the warmed set is continuous (1..pool), so
+    # any restriction within the declared max passes.
     autotune = config.autotune
     if autotune is not None and autotune.get("enabled", True) \
             and autotune.get("buckets"):
@@ -218,9 +270,18 @@ def check_config(path: str, root: str = ".") -> List[Finding]:
                                    rel, anchor, findings)
                 if not shapes:
                     continue
-                warmed = _emission_rows(
-                    tuple(map(tuple, shapes)),
-                    kwargs.get("row_buckets"), rel, anchor, findings)
+                if ragged_on and getattr(cls, "SUPPORTS_RAGGED",
+                                         False):
+                    # ragged stage: one compiled pool shape serves
+                    # every row count up to its capacity, so the
+                    # controller's candidate set is continuous
+                    warmed = set(range(
+                        1, int(tuple(shapes[0])[0]) + 1))
+                else:
+                    warmed = _emission_rows(
+                        tuple(map(tuple, shapes)),
+                        kwargs.get("row_buckets"), rel, anchor,
+                        findings)
                 if warmed is None:
                     continue
                 missing = sorted(restricted - warmed)
@@ -260,7 +321,7 @@ def check_config(path: str, root: str = ".") -> List[Finding]:
                                    rel, edge, findings)
                 _check_edge(rel, edge, p_cls, c_cls, pkwargs, ckwargs,
                             p_step.num_segments, pout, pdtype,
-                            cin, cdtype, findings)
+                            cin, cdtype, findings, ragged_on)
     return findings
 
 
@@ -268,7 +329,8 @@ def _check_edge(rel: str, edge: str, p_cls, c_cls,
                 pkwargs: Dict[str, Any], ckwargs: Dict[str, Any],
                 num_segments: int,
                 pout, pdtype, cin, cdtype,
-                findings: List[Finding]) -> None:
+                findings: List[Finding],
+                ragged_on: bool = False) -> None:
     """Shape/dtype/bucket compatibility of one wired producer-group ->
     consumer-group edge."""
     if cin is None:
@@ -312,17 +374,30 @@ def _check_edge(rel: str, edge: str, p_cls, c_cls,
     # is a silent recompile inside the measured window
     if getattr(c_cls, "REPACKS_ROWS", False):
         return
-    emission = _emission_rows(seg_out, pkwargs.get("row_buckets")
-                              if num_segments <= 1 else None,
-                              rel, edge, findings)
+    if ragged_on and getattr(p_cls, "SUPPORTS_RAGGED", False):
+        # ragged producer: every emission ships the ONE pool shape
+        # (its declared max); any configured row_buckets are the
+        # counterfactual pad rule, never shipped shapes
+        emission = {int(seg_out[0][0])}
+    else:
+        emission = _emission_rows(seg_out, pkwargs.get("row_buckets")
+                                  if num_segments <= 1 else None,
+                                  rel, edge, findings)
     if emission is None:
         return
     # the consumer's warmed set: its configured row_buckets when the
-    # class consumes them, else the single declared input max
+    # class consumes them, else the single declared input max. A
+    # RAGGED consumer warms exactly its pool (the declared max) —
+    # any configured row_buckets are only the counterfactual pad
+    # rule — so a producer pool smaller than the consumer's is a
+    # mid-run recompile this check must catch (e.g. loader
+    # max_clips=15 feeding a runner max_rows=30 under an omitted
+    # ragged.pool_rows: both resolve their own declared max)
     c_max = int(cin[0][0])
     warmed = {c_max}
-    if ("row_buckets" in consumed_config_keys(c_cls)
-            and ckwargs.get("row_buckets")):
+    if not (ragged_on and getattr(c_cls, "SUPPORTS_RAGGED", False)) \
+            and ("row_buckets" in consumed_config_keys(c_cls)
+                 and ckwargs.get("row_buckets")):
         try:
             warmed = set(normalize_row_buckets(
                 ckwargs["row_buckets"], c_max, "declared input max"))

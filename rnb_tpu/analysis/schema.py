@@ -25,9 +25,10 @@ Rules
 * ``RNB-T005`` unparsed-meta-or-trailer: a registered meta-line prefix
   or trailer kind ``parse_utils`` never checks for.
 * ``RNB-T006`` result-field-drift: a ``key=value`` counter written to
-  the Faults:/Cache:/Staging:/Autotune: log-meta lines with no
-  matching ``BenchmarkResult`` field (or vice versa for the
-  cache/fault/staging/autotune field families; dict-valued fields
+  the Faults:/Cache:/Staging:/Autotune:/Trace:/Ragged:/Padding:
+  log-meta lines with no matching ``BenchmarkResult`` field (or vice
+  versa for those counter families; dict-valued fields — bucket
+  counts, per-edge overflows, compile signatures, warmup seconds —
   ride their own JSON meta lines and are exempt).
 * ``RNB-T007`` unregistered-content-stamp: an attribute stamped onto a
   TimeCard (``time_card.x = ...``) that is neither a core TimeCard
@@ -232,7 +233,15 @@ def extract_trailer_kinds(telemetry_path: str, root: str = "."
 COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Staging:": "staging_",
                          "Autotune:": "autotune_",
-                         "Trace:": "trace_"}
+                         "Trace:": "trace_",
+                         "Ragged:": "ragged_",
+                         "Padding:": ""}
+
+#: verbatim-named counter fields (prefix "") the reverse RNB-T006
+#: direction holds to a meta-line counter — the Faults: trio plus the
+#: Padding: line's fields
+VERBATIM_COUNTER_FIELDS = ("num_failed", "num_shed", "num_retries",
+                           "pad_rows", "total_rows", "pad_emissions")
 
 
 def extract_meta_counter_keys(benchmark_path: str) -> Dict[str, Set[str]]:
@@ -439,11 +448,12 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
     dict_fields = {f.name for f in dataclasses.fields(BenchmarkResult)
                    if f.default_factory is dict}
     for field in sorted(fields - dict_fields):
-        if field in ("num_failed", "num_shed", "num_retries") \
+        if field in VERBATIM_COUNTER_FIELDS \
                 or field.startswith("cache_") \
                 or field.startswith("staging_") \
                 or field.startswith("autotune_") \
-                or field.startswith("trace_"):
+                or field.startswith("trace_") \
+                or field.startswith("ragged_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,

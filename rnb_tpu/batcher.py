@@ -20,7 +20,10 @@ import numpy as np
 
 from rnb_tpu import trace
 from rnb_tpu.autotune import BatchController
-from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
+from rnb_tpu.ops.ragged import resolve_pool_rows, segment_offsets_of
+from rnb_tpu.stage import (PadCounter, PaddedBatch, RaggedBatch,
+                           StageModel, normalize_row_buckets,
+                           note_emission_accounting)
 from rnb_tpu.telemetry import TimeCardList
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 
@@ -52,8 +55,14 @@ class Batcher(StageModel):
     #: never had (it waited for `batch` arrivals or end-of-stream)
     SUPPORTS_AUTOTUNE = True
 
+    #: fused emissions can ship as a flat row pool at ONE shape with a
+    #: rows_valid count + per-request segment offsets instead of
+    #: padding to a bucket (root 'ragged' config key)
+    SUPPORTS_RAGGED = True
+
     def __init__(self, device, batch=1, shapes=None, max_rows=MAX_ROWS,
                  consecutive_frames=8, frame_hw=112, row_buckets=None,
+                 ragged=False, ragged_pool_rows=None,
                  **kwargs):
         super().__init__(device)
         self.batch = int(batch)
@@ -70,6 +79,23 @@ class Batcher(StageModel):
         self.row_buckets = (normalize_row_buckets(
             row_buckets, self._declared_max[0], "stage max rows")
             if row_buckets else None)
+        # ragged row-pool dispatch (rnb_tpu.ops.ragged): emissions ship
+        # the full declared shape (the pool) with a rows_valid count +
+        # segment offsets; row_buckets, if configured, become the
+        # COUNTERFACTUAL pad rule the pad_rows_eliminated counter is
+        # measured against, never a shipped shape
+        self.ragged = bool(ragged)
+        self.pool_rows = (resolve_pool_rows(
+            ragged_pool_rows, self._declared_max[0], "stage max rows")
+            if self.ragged else None)
+        #: padding-waste accounting (always on; 0-pad under ragged)
+        self.padding = PadCounter()
+        #: ragged accounting, drained via the executor's ragged sink
+        self.ragged_stats = ({"pool_rows": self.pool_rows,
+                              "emissions": 0, "rows": 0,
+                              "pad_rows_eliminated": 0,
+                              "cache_hit_rows": 0}
+                             if self.ragged else None)
         self._tensors = []      # list of tuples of PaddedBatch
         self._time_cards = []
         #: load-adaptive batching controller (rnb_tpu.autotune), set
@@ -84,7 +110,15 @@ class Batcher(StageModel):
         """Executor protocol (rnb_tpu.runner): drive this stage's
         accumulate/emit decision and pad bucket with a BatchController
         over the stage's own warmed bucket set — decisions can only
-        name shapes the downstream stage warmed."""
+        name shapes the downstream stage warmed. Under ragged dispatch
+        every row count is one dispatch of the same executable, so the
+        candidate set is continuous (1..pool_rows) and decisions stop
+        being bucket-quantized."""
+        if self.ragged:
+            self.autotune = BatchController.for_stage(
+                settings, tuple(range(1, self.pool_rows + 1)),
+                self.pool_rows)
+            return self.autotune
         self.autotune = BatchController.for_stage(
             settings, self.row_buckets or (self._declared_max[0],),
             self._declared_max[0])
@@ -229,6 +263,16 @@ class Batcher(StageModel):
                     return bucket
         return max_rows
 
+    def _counterfactual_bucket(self, rows: int) -> int:
+        """The rows the bucketed pad rule WOULD have shipped for this
+        emission — what pad_rows_eliminated is measured against under
+        ragged (max-shape padding when no row_buckets are named)."""
+        if self.row_buckets:
+            for bucket in self.row_buckets:
+                if rows <= bucket:
+                    return bucket
+        return self._declared_max[0]
+
     def _emit_fused(self):
         if trace.ACTIVE is not None:
             # timeline marker per fused dispatch (args allocated only
@@ -239,10 +283,31 @@ class Batcher(StageModel):
         fused = []
         for pos, parts in enumerate(zip(*self._tensors)):
             valid = sum(pb.valid for pb in parts)
-            bucket = self._bucket_for(valid, self._declared_max[pos])
+            if self.ragged:
+                # one compiled shape: the pool is the declared max;
+                # the segment table partitions the valid rows per
+                # constituent request
+                bucket = self._declared_max[pos]
+            else:
+                bucket = self._bucket_for(valid, self._declared_max[pos])
             if pos == 0 and self.autotune is not None:
-                self.autotune.note_emission(bucket)
-            fused.append(self._fuse_parts(parts, valid, bucket))
+                self.autotune.note_emission(valid if self.ragged
+                                            else bucket)
+            if pos == 0:
+                # the shared padding/ragged accounting rule
+                # (rnb_tpu.stage.note_emission_accounting): pad count
+                # stamped on the first constituent card; under ragged
+                # the counterfactual bucket feeds pad_rows_eliminated
+                note_emission_accounting(
+                    self.padding, self.ragged_stats, self._time_cards,
+                    valid, bucket,
+                    self._counterfactual_bucket(valid) if self.ragged
+                    else 0)
+            pb = self._fuse_parts(parts, valid, bucket)
+            if self.ragged and pos == 0:
+                pb = RaggedBatch(pb.data, valid, segment_offsets_of(
+                    part.valid for part in parts))
+            fused.append(pb)
 
         cards = TimeCardList(self._time_cards)
         self._tensors = []

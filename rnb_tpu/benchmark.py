@@ -145,6 +145,35 @@ class BenchmarkResult:
     #: trace-off runs)
     trace_events: int = 0
     trace_dropped: int = 0
+    #: padding-waste accounting (rnb_tpu.stage.PadCounter), summed
+    #: over every batching stage instance: pad rows shipped /
+    #: total rows shipped / emissions. Under ragged dispatch the
+    #: consumer's kernel computes no pad rows, so pad_rows stays ~0
+    #: and the waste the bucketed rule would have burned lands in
+    #: ragged_pad_rows_eliminated instead.
+    pad_rows: int = 0
+    total_rows: int = 0
+    pad_emissions: int = 0
+    #: ragged row-pool dispatch accounting (rnb_tpu.ops.ragged),
+    #: summed over every ragged stage instance; all zero without the
+    #: `ragged` root config key. rows = valid rows shipped across all
+    #: pool emissions; pad_rows_eliminated = what the bucketed pad
+    #: rule would have shipped on top; cache_hit_rows = rows served
+    #: into pools from the row-extent clip cache.
+    ragged_pool_rows: int = 0
+    ragged_emissions: int = 0
+    ragged_rows: int = 0
+    ragged_pad_rows_eliminated: int = 0
+    ragged_cache_hit_rows: int = 0
+    #: per-step jit-entry signature accounting
+    #: (rnb_tpu.compilestats): {step: {warmup, steady_new,
+    #: steady_calls}} — steady_new > 0 means a mid-run recompile; a
+    #: ragged stage's warmup is exactly 1
+    compile_signatures: Dict[str, Dict[str, int]] = \
+        field(default_factory=dict)
+    #: per-step stage-construction wall seconds (weights + warmup
+    #: compiles), summed over the step's instances
+    warmup_s: Dict[str, float] = field(default_factory=dict)
 
 
 def run_benchmark(config_path: str,
@@ -203,6 +232,9 @@ def run_benchmark(config_path: str,
     cache_sink: list = []
     staging_sink: list = []
     autotune_sink: list = []
+    compile_sink: list = []
+    pad_sink: list = []
+    ragged_sink: list = []
     fault_stats = FaultStats()
     # load-adaptive batching (rnb_tpu.autotune): one validated settings
     # object shared by every participating stage; per-step opt-out via
@@ -231,6 +263,34 @@ def run_benchmark(config_path: str,
                   "or unsupported) — batching stays static and no "
                   "Autotune: telemetry will be emitted",
                   file=sys.stderr)
+    # ragged row-pool dispatch (root 'ragged' config key,
+    # rnb_tpu.ops.ragged): supporting stages get the kwargs injected —
+    # the keys are runtime wiring, not user config, so the static
+    # unconsumed-key check never sees them and a non-supporting stage
+    # (mesh runner, single-step baseline) simply stays bucketed
+    from rnb_tpu.ops.ragged import RaggedSettings
+    ragged_settings = RaggedSettings.from_config(config.ragged)
+    ragged_kwargs_by_step: Dict[int, Dict[str, Any]] = {}
+    if ragged_settings is not None:
+        from rnb_tpu.utils.class_utils import load_class as _load_cls
+        any_ragged = False
+        for step_idx, step in enumerate(config.steps):
+            try:
+                supports = getattr(_load_cls(step.model),
+                                   "SUPPORTS_RAGGED", False)
+            except Exception:
+                supports = False
+            if supports:
+                any_ragged = True
+                ragged_kwargs_by_step[step_idx] = {
+                    "ragged": True,
+                    "ragged_pool_rows": ragged_settings.pool_rows}
+        if not any_ragged:
+            print("[rnb-tpu] WARNING: ragged is enabled but no "
+                  "pipeline stage supports it — every emission stays "
+                  "bucketed and no Ragged: telemetry will be emitted",
+                  file=sys.stderr)
+
     fault_plan = FaultPlan.resolve(config.fault_plan)
     if fault_plan is not None:
         # env-provided plans bypass config parsing — re-check their
@@ -306,6 +366,9 @@ def run_benchmark(config_path: str,
         is_final = step_idx == config.num_steps - 1
         for group_idx, group in enumerate(step.groups):
             model_kwargs = step.kwargs_for_group(group_idx)
+            if step_idx in ragged_kwargs_by_step:
+                model_kwargs = dict(model_kwargs,
+                                    **ragged_kwargs_by_step[step_idx])
             for instance_idx, device in enumerate(group.devices):
                 in_queue, out_queues = fabric.get_queues(step_idx,
                                                          group_idx)
@@ -348,6 +411,9 @@ def run_benchmark(config_path: str,
                     autotune=(autotune_settings if step.autotune
                               else None),
                     autotune_sink=autotune_sink,
+                    compile_sink=compile_sink,
+                    pad_sink=pad_sink,
+                    ragged_sink=ragged_sink,
                     tracer=tracer,
                 )
                 threads.append(threading.Thread(
@@ -510,6 +576,28 @@ def run_benchmark(config_path: str,
         from rnb_tpu.autotune import aggregate_snapshots as \
             aggregate_autotune
         autotune_stats = aggregate_autotune(autotune_sink)
+    # compile/warmup + padding + ragged accounting (every stage reports
+    # warmup; jit-owning stages report signatures; batching stages
+    # report pad counters; ragged stages report pool counters)
+    from rnb_tpu.compilestats import aggregate_compile_records
+    compile_stats, warmup_stats = aggregate_compile_records(compile_sink)
+    pad_stats = None
+    if pad_sink:
+        pad_stats = {"pad_rows": 0, "total_rows": 0, "emissions": 0}
+        for snap in pad_sink:
+            for key in pad_stats:
+                pad_stats[key] += int(snap.get(key, 0))
+    ragged_stats = None
+    if ragged_sink:
+        ragged_stats = {"pool_rows": 0, "emissions": 0, "rows": 0,
+                        "pad_rows_eliminated": 0, "cache_hit_rows": 0}
+        for snap in ragged_sink:
+            ragged_stats["pool_rows"] = max(
+                ragged_stats["pool_rows"],
+                int(snap.get("pool_rows") or 0))
+            for key in ("emissions", "rows", "pad_rows_eliminated",
+                        "cache_hit_rows"):
+                ragged_stats[key] += int(snap.get(key, 0))
 
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
@@ -581,6 +669,32 @@ def run_benchmark(config_path: str,
                 f.write("Autotune buckets: %s\n"
                         % json.dumps(autotune_stats["bucket_counts"],
                                      sort_keys=True))
+        if pad_stats is not None:
+            # padding-waste accounting over every batching stage: the
+            # bucketed path quantifies its pad work; a ragged run shows
+            # ~0 here (pad FLOPs land in Ragged: pad_rows_eliminated)
+            f.write("Padding: pad_rows=%d total_rows=%d "
+                    "pad_emissions=%d\n"
+                    % (pad_stats["pad_rows"], pad_stats["total_rows"],
+                       pad_stats["emissions"]))
+        if ragged_stats is not None:
+            # only ragged-enabled runs carry the line, keeping bucketed
+            # logs byte-stable with the earlier schema
+            f.write("Ragged: pool_rows=%d emissions=%d rows=%d "
+                    "pad_rows_eliminated=%d cache_hit_rows=%d\n"
+                    % (ragged_stats["pool_rows"],
+                       ragged_stats["emissions"], ragged_stats["rows"],
+                       ragged_stats["pad_rows_eliminated"],
+                       ragged_stats["cache_hit_rows"]))
+        if compile_stats:
+            # per-step jit-entry signatures: warmup vocabulary size +
+            # signatures first seen inside the measured window
+            # (steady_new > 0 = mid-run recompile; --check fails it)
+            f.write("Compiles: %s\n"
+                    % json.dumps(compile_stats, sort_keys=True))
+        if warmup_stats:
+            f.write("Warmup: %s\n"
+                    % json.dumps(warmup_stats, sort_keys=True))
         if tracer is not None:
             # trace-export accounting: events written to trace.json
             # and events dropped at the max_events cap — parse_utils
@@ -650,6 +764,23 @@ def run_benchmark(config_path: str,
                  autotune_stats["emissions"],
                  json.dumps(autotune_stats["bucket_counts"],
                             sort_keys=True)))
+    if ragged_stats is not None and print_progress:
+        print("Ragged: %d emission(s), %d valid row(s) at pool_rows=%d"
+              ", %d pad row(s) eliminated vs the bucketed rule, "
+              "%d cache-hit row(s)"
+              % (ragged_stats["emissions"], ragged_stats["rows"],
+                 ragged_stats["pool_rows"],
+                 ragged_stats["pad_rows_eliminated"],
+                 ragged_stats["cache_hit_rows"]))
+    recompiled = sorted(step for step, sigs in compile_stats.items()
+                        if sigs.get("steady_new", 0) > 0)
+    if recompiled:
+        # a signature first seen inside the measured window is a
+        # silent XLA compile on the hot path — exactly what warmup
+        # (and the ragged one-shape contract) exists to prevent
+        print("[rnb-tpu] WARNING: mid-run recompile signature(s) on %s "
+              "(Compiles: steady_new > 0)" % ", ".join(recompiled),
+              file=sys.stderr)
     if phases_stats is not None and print_progress:
         print("Phases (per-request attribution, mean/p99 ms):")
         for phase in sorted_phases(phases_stats):
@@ -729,6 +860,20 @@ def run_benchmark(config_path: str,
         phases=dict(phases_stats) if phases_stats else {},
         trace_events=trace_events,
         trace_dropped=trace_dropped,
+        pad_rows=pad_stats["pad_rows"] if pad_stats else 0,
+        total_rows=pad_stats["total_rows"] if pad_stats else 0,
+        pad_emissions=pad_stats["emissions"] if pad_stats else 0,
+        ragged_pool_rows=(ragged_stats["pool_rows"]
+                          if ragged_stats else 0),
+        ragged_emissions=(ragged_stats["emissions"]
+                          if ragged_stats else 0),
+        ragged_rows=ragged_stats["rows"] if ragged_stats else 0,
+        ragged_pad_rows_eliminated=(
+            ragged_stats["pad_rows_eliminated"] if ragged_stats else 0),
+        ragged_cache_hit_rows=(ragged_stats["cache_hit_rows"]
+                               if ragged_stats else 0),
+        compile_signatures=compile_stats,
+        warmup_s=warmup_stats,
     )
 
 
@@ -804,6 +949,9 @@ def main(argv=None) -> int:
                  if cfg.autotune else "none",
                  "; opted-out steps: %s" % opted_out
                  if opted_out else ""))
+        print("ragged: %s"
+              % (json.dumps(cfg.ragged, sort_keys=True)
+                 if cfg.ragged else "none"))
         print("trace: %s"
               % (json.dumps(cfg.trace, sort_keys=True)
                  if cfg.trace else "none"))

@@ -139,14 +139,11 @@ class ClipCache:
         with self._lock:
             self.num_coalesced += n
 
-    def insert_device(self, key: tuple, device_batch, valid: int) -> bool:
-        """Insert an already-transferred padded device batch.
-
-        Returns False when the entry was skipped (oversize, or the key
-        is already resident — first writer wins, the bytes are
-        identical by content-addressing).
-        """
-        nbytes = int(device_batch.nbytes)
+    def _insert(self, key: tuple, batch, valid: int,
+                nbytes: int) -> bool:
+        """The one locked insert body every flavor shares: first
+        writer wins, oversize skipped (counted), LRU-evict until the
+        entry fits."""
         with self._lock:
             if key in self._entries:
                 return False
@@ -158,10 +155,20 @@ class ClipCache:
                 _, evicted = self._entries.popitem(last=False)
                 self.resident_bytes -= evicted.nbytes
                 self.num_evictions += 1
-            self._entries[key] = CacheEntry(device_batch, valid, nbytes)
+            self._entries[key] = CacheEntry(batch, valid, nbytes)
             self.resident_bytes += nbytes
             self.num_inserts += 1
             return True
+
+    def insert_device(self, key: tuple, device_batch, valid: int) -> bool:
+        """Insert an already-transferred padded device batch.
+
+        Returns False when the entry was skipped (oversize, or the key
+        is already resident — first writer wins, the bytes are
+        identical by content-addressing).
+        """
+        return self._insert(key, device_batch, valid,
+                            int(device_batch.nbytes))
 
     def insert_host(self, key: tuple, clips, valid: int,
                     target_shape: Tuple[int, ...]) -> bool:
@@ -193,6 +200,25 @@ class ClipCache:
         padded[:valid] = clips[:valid]
         device_batch = jax.device_put(padded, self.device)
         return self.insert_device(key, device_batch, valid)
+
+    def insert_rows(self, key: tuple, clips, valid: int) -> bool:
+        """Insert a **host row extent**: exactly ``valid`` decoded rows,
+        no bucket padding, no device transfer (ragged dispatch mode,
+        rnb_tpu.ops.ragged).
+
+        Under ragged row-pool dispatch there is no per-request padded
+        device batch to reuse — hit rows are *filled into the pool*
+        alongside fresh decodes and ride the pool's single transfer —
+        so the cached value is the minimal thing that skips the decode:
+        the raw rows. Copies out of the caller's buffer (which may be a
+        staging-slot view about to recycle, same contract as
+        :meth:`insert_host`), and charges exactly ``valid`` rows of
+        bytes — a 1-clip entry costs 1/15th of its bucket-padded
+        equivalent.
+        """
+        valid = int(valid)
+        rows = np.array(np.asarray(clips)[:valid], dtype=np.uint8)
+        return self._insert(key, rows, valid, int(rows.nbytes))
 
     def snapshot(self) -> Dict[str, int]:
         """Point-in-time counter copy for reports."""

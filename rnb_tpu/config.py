@@ -36,7 +36,7 @@ RESERVED_KEYWORDS = [
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
-    "trace", "_comment",
+    "trace", "ragged", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -48,6 +48,9 @@ AUTOTUNE_KEYWORDS = ["enabled", "slo_ms", "ewma_alpha", "min_hold_ms",
 
 #: keys a root 'trace' object may carry (rnb_tpu.trace)
 TRACE_KEYWORDS = ["enabled", "sample_hz", "max_events"]
+
+#: keys a root 'ragged' object may carry (rnb_tpu.ops.ragged)
+RAGGED_KEYWORDS = ["enabled", "pool_rows"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -153,6 +156,13 @@ class PipelineConfig:
     #: builds rnb_tpu.autotune.AutotuneSettings from it and every
     #: batching stage not opted out gets a BatchController
     autotune: Optional[Dict[str, Any]] = None
+    #: validated ragged row-pool dispatch spec ({"enabled": ..,
+    #: "pool_rows": ..}), or None; when enabled the launcher injects
+    #: ``ragged``/``ragged_pool_rows`` kwargs into every
+    #: ``SUPPORTS_RAGGED`` stage (rnb_tpu.ops.ragged): stages dispatch
+    #: a flat row pool at ONE compiled shape with a rows_valid scalar
+    #: and per-request segment offsets instead of padding to buckets
+    ragged: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -294,6 +304,31 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 and not isinstance(max_events, bool) and max_events >= 1,
                 "'trace.max_events' must be a positive integer, got %r"
                 % (max_events,))
+
+    ragged = raw.get("ragged")
+    if ragged is not None:
+        _expect(isinstance(ragged, dict), "'ragged' must be an object")
+        unknown_rg = sorted(set(ragged) - set(RAGGED_KEYWORDS))
+        _expect(not unknown_rg,
+                "'ragged' has unknown key(s) %s — keys are %s"
+                % (unknown_rg, RAGGED_KEYWORDS))
+        _expect(isinstance(ragged.get("enabled", True), bool),
+                "'ragged.enabled' must be a boolean")
+        pool_rows = ragged.get("pool_rows")
+        _expect(pool_rows is None
+                or (isinstance(pool_rows, int)
+                    and not isinstance(pool_rows, bool)
+                    and pool_rows >= 1),
+                "'ragged.pool_rows' must be a positive integer "
+                "(the flat row pool's capacity), got %r" % (pool_rows,))
+        if ragged.get("enabled", True):
+            # the pool is ONE fixed shape; a row-split into segments
+            # would need per-segment pool shapes — reject like the
+            # row_buckets/segments combination above
+            _expect(all(step.get("num_segments", 1) == 1
+                        for step in pipeline if isinstance(step, dict)),
+                    "'ragged' cannot be combined with 'num_segments' "
+                    "> 1: the pool is one fixed dispatch shape")
 
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
@@ -477,4 +512,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           fault_plan=fault_plan,
                           popularity=popularity,
                           autotune=autotune,
+                          ragged=ragged,
                           trace=trace)

@@ -30,6 +30,7 @@ import numpy as np
 from rnb_tpu import hostprof, trace
 from rnb_tpu.autotune import BatchController
 from rnb_tpu.cache import content_key
+from rnb_tpu.compilestats import SignatureTracker
 from rnb_tpu.decode import get_decoder
 from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_RGB,
                                    PIX_YUV420, default_decode_threads,
@@ -41,9 +42,12 @@ from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
                                           R2Plus1DClassifier,
                                           R18_LAYER_SIZES)
 from rnb_tpu.models.r2p1d.sampler import R2P1DSampler
+from rnb_tpu.ops.ragged import resolve_pool_rows, segment_offsets_of
 from rnb_tpu.ops.yuv import packed_frame_bytes
 from rnb_tpu.selector import QueueSelector
-from rnb_tpu.stage import PaddedBatch, StageModel, normalize_row_buckets
+from rnb_tpu.stage import (PadCounter, PaddedBatch, RaggedBatch,
+                           StageModel, normalize_row_buckets,
+                           note_emission_accounting)
 from rnb_tpu.staging import StagingPool, TransferWorker
 from rnb_tpu.telemetry import TimeCard, TimeCardList
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
@@ -84,18 +88,48 @@ def _resolve(device):
 _normalize_row_buckets = normalize_row_buckets
 
 
+def default_ragged_chunk(pool_rows: int) -> int:
+    """Auto row-chunk for the ragged applier's dynamic grid: the
+    largest divisor of the pool capacity no bigger than a third of it
+    (so a typical partial pool skips real work), floored at 1. 15 ->
+    5, 12 -> 4, 2 -> 1."""
+    pool_rows = int(pool_rows)
+    cap = max(1, pool_rows // 3)
+    for d in range(cap, 0, -1):
+        if pool_rows % d == 0:
+            return d
+    return 1
+
+
 def _shared_apply(start: int, end: int, num_classes: int,
                   layer_sizes: tuple, factored_shortcut: bool = False,
-                  pixel_path: str = "rgb"):
+                  pixel_path: str = "rgb", ragged: bool = False,
+                  ragged_chunk: int = 0):
     """One jitted inference applier shared by every replica of a range.
 
     ``pixel_path="yuv420"`` (layer-1 stages only) prepends the fused
     ingest — packed 4:2:0 planes -> chroma upsample -> BT.601 ->
     normalize (rnb_tpu/ops/yuv.py) — inside the same jit, so XLA fuses
     the colourspace math with the first convolution's input pipeline.
+
+    ``ragged`` swaps the contract for the ragged row-pool one
+    (rnb_tpu/ops/ragged.py): the applier takes the flat pool plus a
+    *traced* ``rows_valid`` scalar and compiles exactly ONCE for any
+    batch composition — for yuv420 the fused ingest masks the pool
+    tail at the u8 level first. With ``ragged_chunk`` > 0 (must
+    divide the pool capacity) the network body runs as a dynamic grid
+    over fixed ``ragged_chunk``-row tiles: a ``fori_loop`` whose trip
+    count is ``ceil(rows_valid / chunk)``, so network FLOPs scale
+    with the valid rows (rounded up to one tile) instead of the pool
+    capacity — the CPU/compile-once analog of the TPU kernel's
+    ``pl.when`` grid skip, and bit-identical per row (tiles of any
+    size produce the same per-row outputs; asserted in
+    tests/test_ragged.py). ``ragged_chunk=0`` applies the whole pool
+    in one call (preferable on real TPUs, where the MXU wants the
+    large batch and the Pallas ingest already skips pad arithmetic).
     """
     key = (start, end, num_classes, layer_sizes, factored_shortcut,
-           pixel_path)
+           pixel_path, bool(ragged), int(ragged_chunk))
     with _cache_lock:
         fn = _apply_cache.get(key)
         if fn is None:
@@ -105,7 +139,51 @@ def _shared_apply(start: int, end: int, num_classes: int,
                                        layer_sizes=layer_sizes,
                                        factored_shortcut=factored_shortcut)
 
-            if pixel_path == "yuv420":
+            if ragged:
+                if pixel_path == "yuv420":
+                    from rnb_tpu.ops.ragged import ragged_normalize_yuv420
+
+                    def ingest(x, rows_valid):
+                        return ragged_normalize_yuv420(
+                            x, rows_valid, FRAME_HW, FRAME_HW)
+                else:
+                    # rgb/mid-pipeline pools arrive already normalized
+                    # and masked by the producing loader's ragged
+                    # preprocess
+                    def ingest(x, rows_valid):
+                        del rows_valid
+                        return x
+                chunk = int(ragged_chunk)
+
+                def apply(variables, x, rows_valid):
+                    import jax.numpy as jnp
+                    from jax import lax
+                    xin = ingest(x, rows_valid)
+                    if chunk <= 0 or chunk >= xin.shape[0]:
+                        return model.apply(variables, xin, train=False)
+
+                    def tile(i):
+                        part = lax.dynamic_slice_in_dim(
+                            xin, i * chunk, chunk, axis=0)
+                        return model.apply(variables, part, train=False)
+
+                    # tile 0 is computed unconditionally (every real
+                    # emission carries >= 1 valid row) — it also fixes
+                    # the output row shape/dtype without re-tracing
+                    first = tile(0)
+                    out = lax.dynamic_update_slice_in_dim(
+                        jnp.zeros((xin.shape[0],) + first.shape[1:],
+                                  first.dtype), first, 0, axis=0)
+                    num_tiles = jnp.minimum(
+                        (rows_valid + chunk - 1) // chunk,
+                        xin.shape[0] // chunk)
+
+                    def body(i, acc):
+                        return lax.dynamic_update_slice_in_dim(
+                            acc, tile(i), i * chunk, axis=0)
+
+                    return lax.fori_loop(1, num_tiles, body, out)
+            elif pixel_path == "yuv420":
                 from rnb_tpu.ops.yuv import normalize_yuv420
 
                 def apply(variables, x):
@@ -147,6 +225,27 @@ def _shared_preprocess(device):
             import jax
             from rnb_tpu.models.r2p1d.network import normalize_u8
             fn = jax.jit(normalize_u8)
+            _preprocess_cache[key] = fn
+        return fn
+
+
+def _shared_ragged_preprocess(device):
+    """Jitted ragged uint8 pool -> normalized bfloat16, one per
+    device: the ragged forward primitive (rnb_tpu/ops/ragged.py) with
+    a *traced* rows_valid scalar — one executable serves every batch
+    composition, and rows past rows_valid cost no arithmetic on the
+    TPU grid-skip path."""
+    key = ("ragged", id(device))
+    with _cache_lock:
+        fn = _preprocess_cache.get(key)
+        if fn is None:
+            import jax
+            from rnb_tpu.ops.ragged import ragged_normalize_u8
+
+            def preprocess(pool, rows_valid):
+                return ragged_normalize_u8(pool, rows_valid)
+
+            fn = jax.jit(preprocess)
             _preprocess_cache[key] = fn
         return fn
 
@@ -247,6 +346,12 @@ class R2P1DLoader(StageModel):
     #: loader's complete() contract is synchronous
     SUPPORTS_TRANSFER_ASYNC = False
 
+    #: emissions can ship as a flat row pool at ONE compiled shape
+    #: with a rows_valid count + per-request segment offsets instead
+    #: of padding to buckets (root 'ragged' config key; the launcher
+    #: injects the kwargs — rnb_tpu.ops.ragged)
+    SUPPORTS_RAGGED = True
+
     def __init__(self, device, max_clips: int = MAX_CLIPS,
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
                  num_clips_population=None, weights=None,
@@ -256,6 +361,7 @@ class R2P1DLoader(StageModel):
                  pixel_path: str = "rgb", cache_mb: float = 0,
                  staging_slots=None, transfer_async: bool = False,
                  fallback_decode_threads=None,
+                 ragged: bool = False, ragged_pool_rows=None,
                  **kwargs):
         super().__init__(device)
         import jax
@@ -295,6 +401,28 @@ class R2P1DLoader(StageModel):
         self.row_buckets = _normalize_row_buckets(row_buckets,
                                                   self.max_clips,
                                                   "max_clips")
+        # Ragged row-pool dispatch (rnb_tpu.ops.ragged): every emission
+        # ships the ONE pool shape with an explicit rows_valid + per-
+        # request segment offsets — no bucket padding, one warmup
+        # compile, continuous autotune. row_buckets, if configured,
+        # stop being shipped shapes and become the COUNTERFACTUAL pad
+        # rule the pad_rows_eliminated counter is measured against.
+        self.ragged = bool(ragged)
+        self.pool_rows = (resolve_pool_rows(ragged_pool_rows,
+                                            self.max_clips, "max_clips")
+                          if self.ragged else None)
+        if self.ragged and self.raw_output:
+            raise ValueError("ragged cannot be combined with "
+                             "raw_output: mesh consumers shard a fixed "
+                             "clip axis, not a rows_valid pool")
+        #: padding-waste accounting (PadCounter; 0-pad under ragged)
+        self.padding = PadCounter()
+        #: ragged accounting, drained via the executor's ragged sink
+        self.ragged_stats = ({"pool_rows": self.pool_rows,
+                              "emissions": 0, "rows": 0,
+                              "pad_rows_eliminated": 0,
+                              "cache_hit_rows": 0}
+                             if self.ragged else None)
         if self.raw_output and len(self.row_buckets) > 1:
             # raw consumers (R2P1DMeshRunner) shard the clip axis over a
             # fixed mesh — a variable bucketed clip axis cannot satisfy
@@ -379,25 +507,56 @@ class R2P1DLoader(StageModel):
                 "r2p1d", tuple(self.sampler.num_clips_population),
                 tuple(float(p) for p in self.sampler.probabilities),
                 self.consecutive_frames, FRAME_HW, self.pixel_path,
-                self.max_clips, self.row_buckets)
+                self.max_clips, self.row_buckets,
+                # ragged entries hold host row extents, bucketed ones
+                # padded device batches — the two must never alias
+                self.ragged)
+        self._preprocess_ragged = None
+        #: jit-entry signature accounting (rnb_tpu.compilestats):
+        #: distinct preprocess input signatures == executables this
+        #: stage requires; frozen by the executor at window start so
+        #: any later new signature surfaces as a mid-run recompile
+        self.compiles = None
         if self.raw_output or self.pixel_path == "yuv420":
             # raw mode: consumer normalizes on its mesh. yuv420: the
             # network stage's jit owns the whole ingest; the loader
-            # ships packed u8 — warm only the transfer path per bucket
+            # ships packed u8 — warm only the transfer path (one shape
+            # per bucket; ONE pool shape under ragged — device_put
+            # itself never compiles)
             self._preprocess = None
-            for bucket in self.row_buckets:
-                dummy = np.zeros(self._batch_shape(bucket),
+            for rows in self._warm_shapes():
+                dummy = np.zeros(self._batch_shape(rows),
                                  dtype=np.uint8)
                 for _ in range(num_warmups):
                     jax.block_until_ready(
                         jax.device_put(dummy, self._jax_device))
+        elif self.ragged:
+            # ragged ingest: ONE compiled executable serves every
+            # batch composition — the rows_valid scalar is traced,
+            # and the TPU kernel's grid skip spends no arithmetic on
+            # rows past it (rnb_tpu/ops/ragged.py)
+            self._preprocess = None
+            self._preprocess_ragged = _shared_ragged_preprocess(
+                self._jax_device)
+            self.compiles = SignatureTracker()
+            dummy = np.zeros(self._batch_shape(self.pool_rows),
+                             dtype=np.uint8)
+            # vocabulary declared even under num_warmups=0 (see the
+            # runner's warmup loop)
+            self.compiles.observe(dummy)
+            for _ in range(num_warmups):
+                jax.block_until_ready(self._preprocess_ragged(
+                    jax.device_put(dummy, self._jax_device),
+                    np.int32(self.pool_rows)))
         else:
             self._preprocess = _shared_preprocess(self._jax_device)
+            self.compiles = SignatureTracker()
             # warm-up: compile the preprocess for every bucket shape and
             # fault in the transfer path
-            for bucket in self.row_buckets:
-                dummy = np.zeros(self._batch_shape(bucket),
+            for rows in self._warm_shapes():
+                dummy = np.zeros(self._batch_shape(rows),
                                  dtype=np.uint8)
+                self.compiles.observe(dummy)
                 for _ in range(num_warmups):
                     jax.block_until_ready(self._preprocess(
                         jax.device_put(dummy, self._jax_device)))
@@ -473,9 +632,54 @@ class R2P1DLoader(StageModel):
         deadlocking against its own complete() (see __init__)."""
         return self.prefetch_depth + 2
 
+    def _warm_shapes(self):
+        """Row counts warm-up must fault in: the bucket vocabulary —
+        or the ONE pool shape under ragged dispatch."""
+        return (self.pool_rows,) if self.ragged else self.row_buckets
+
+    def _ship_rows(self, n: int) -> int:
+        """Rows an emission holding ``n`` valid rows actually ships:
+        its pad bucket — or the fixed pool capacity under ragged."""
+        return self.pool_rows if self.ragged else self._bucket_for(n)
+
+    def _note_emission_padding(self, valid: int, shipped: int,
+                               cards) -> None:
+        """Padding-waste + ragged accounting for one emission (the
+        shared rule, rnb_tpu.stage.note_emission_accounting); the
+        counterfactual under ragged is this stage's configured bucket
+        vocabulary (max-shape padding when none is named), so a
+        same-seed bucketed arm's pad_rows equals pad_rows_eliminated
+        exactly."""
+        note_emission_accounting(
+            self.padding, self.ragged_stats, cards, valid, shipped,
+            self._bucket_for(valid) if self.ragged else 0)
+
+    def _normalize_emission(self, device_u8, valid: int):
+        """The one preprocess dispatch every emission path shares:
+        bucketed jit, ragged jit (traced rows_valid scalar), or a
+        pass-through for raw/yuv consumers. Observes the jit-entry
+        signature for the Compiles: accounting."""
+        if self._preprocess_ragged is not None:
+            self.compiles.observe(device_u8)
+            return self._preprocess_ragged(device_u8, np.int32(valid))
+        if self._preprocess is not None:
+            self.compiles.observe(device_u8)
+            return self._preprocess(device_u8)
+        return device_u8
+
+    def _wrap_batch(self, data, valid: int, offsets=None):
+        """The emitted tensor: a RaggedBatch carrying the segment
+        table under ragged dispatch, the seed PaddedBatch otherwise."""
+        if self.ragged:
+            return RaggedBatch(data, valid,
+                               tuple(offsets) if offsets is not None
+                               else (0, int(valid)))
+        return PaddedBatch(data, valid)
+
     def _staging_shapes(self):
-        """One sub-pool per emitted bucket shape."""
-        return [self._batch_shape(b) for b in self.row_buckets]
+        """One sub-pool per emitted bucket shape (ONE pool shape under
+        ragged dispatch)."""
+        return [self._batch_shape(rows) for rows in self._warm_shapes()]
 
     def _stage_target(self, n: int):
         """Decode-target buffer for one native request:
@@ -484,7 +688,7 @@ class R2P1DLoader(StageModel):
         (the copy fallback, baselined under RNB-H007)."""
         if self.staging is not None:
             slot = self.staging.acquire(
-                self._batch_shape(self._bucket_for(n)))
+                self._batch_shape(self._ship_rows(n)))
             self.staging.add_ref(slot)
             return slot.buf[:n], slot, 0
         return np.empty(self._batch_shape(n), dtype=np.uint8), None, 0
@@ -590,9 +794,24 @@ class R2P1DLoader(StageModel):
     def _materialize_hit(self, entry, time_card):
         """Serve one request from a cache entry: no decode, no
         transfer — straight into the same jitted preprocess a miss
-        feeds (or as-is for raw/yuv420 consumers)."""
+        feeds (or as-is for raw/yuv420 consumers).
+
+        Under ragged dispatch the entry is a **host row extent**
+        (rnb_tpu.cache.insert_rows): the decode is skipped but the
+        rows re-pad into the pool and ride a fresh transfer — the
+        pool is the one dispatch shape, so there is no per-request
+        padded device value to serve zero-copy (README "Ragged
+        dispatch" documents the trade)."""
         time_card.num_clips = entry.valid
         time_card.cache_hit = True
+        if self.ragged:
+            if self.ragged_stats is not None:
+                self.ragged_stats["cache_hit_rows"] += entry.valid
+            if self._trace_step is not None:
+                _record_clamped(time_card, "decode%d_done"
+                                % self._trace_step, time.time())
+            return self._materialize(entry.batch, entry.valid,
+                                     time_card)
         if self._trace_step is not None:
             # a hit pays no decode/hold/transfer: zero-length phases
             # keep every card's key sequence identical per instance
@@ -602,10 +821,11 @@ class R2P1DLoader(StageModel):
             _record_clamped(time_card, "decode%d_done" % step, now)
             _record_clamped(time_card, "transfer%d_start" % step, now)
             _record_clamped(time_card, "transfer%d_done" % step, now)
-        if self._preprocess is None:
-            return (PaddedBatch(entry.batch, entry.valid),), None, \
-                time_card
-        return (PaddedBatch(self._preprocess(entry.batch),
+        self._note_emission_padding(entry.valid,
+                                    int(entry.batch.shape[0]),
+                                    [time_card])
+        return (PaddedBatch(self._normalize_emission(entry.batch,
+                                                     entry.valid),
                             entry.valid),), None, time_card
 
     def submit(self, non_tensors, time_card) -> _DecodeHandle:
@@ -725,14 +945,27 @@ class R2P1DLoader(StageModel):
         completed, so failed/contained requests never populate entries.
         """
         jax, _ = _jax_numpy()
-        target = self._batch_shape(self._bucket_for(n))
+        target = self._batch_shape(self._ship_rows(n))
         if clips.shape == target:
             # bucket == clip count (the dominant 1-clip case): the
             # decode buffer already is the transfer buffer — no pad copy
             padded = clips
+        elif self.ragged:
+            # ragged consumers mask rows >= rows_valid in-jit, so the
+            # pool tail can stay uninitialized — for the dominant
+            # 1-clip request that skips a pool-minus-one-row memset
+            padded = np.empty(target, dtype=np.uint8)
+            padded[:n] = clips
         else:
             padded = np.zeros(target, dtype=np.uint8)
             padded[:n] = clips
+        if cache_key is not None and self.cache is not None \
+                and self.ragged:
+            # ragged entries are host row extents (exactly n rows,
+            # no pool padding) — copied out here, before the transfer,
+            # while the decode buffer is live
+            with hostprof.section("loader.cache_insert"):
+                self.cache.insert_rows(cache_key, clips, n)
         if self._trace_step is not None:
             _record_clamped(time_card,
                             "transfer%d_start" % self._trace_step,
@@ -743,17 +976,15 @@ class R2P1DLoader(StageModel):
             _record_clamped(time_card,
                             "transfer%d_done" % self._trace_step,
                             time.time())
-        if cache_key is not None and self.cache is not None:
+        if cache_key is not None and self.cache is not None \
+                and not self.ragged:
             # zero-copy insert: the padded device array IS the cached
             # value (immutable jax.Array) — no extra transfer
             with hostprof.section("loader.cache_insert"):
                 self.cache.insert_device(cache_key, device_u8, n)
-        if self._preprocess is None:
-            # raw_output (mesh consumer) or yuv420 (network stage owns
-            # the fused ingest): u8 crosses the wire as-is
-            return (PaddedBatch(device_u8, n),), None, time_card
-        batch = self._preprocess(device_u8)
-        return (PaddedBatch(batch, n),), None, time_card
+        self._note_emission_padding(n, int(target[0]), [time_card])
+        batch = self._normalize_emission(device_u8, n)
+        return (self._wrap_batch(batch, n),), None, time_card
 
     def _materialize_slot(self, handle: _DecodeHandle, time_card,
                           cache_key=None):
@@ -767,8 +998,18 @@ class R2P1DLoader(StageModel):
         memory a live device batch still reads)."""
         jax, _ = _jax_numpy()
         slot, n = handle.slot, handle.n
-        if n < slot.buf.shape[0]:
+        if n < slot.buf.shape[0] and not self.ragged:
+            # bucketed byte parity needs a zeroed pad tail; under
+            # ragged every consumer masks rows >= rows_valid inside
+            # its jit (rnb_tpu/ops/ragged.py contract), so the memset
+            # — up to pool-1 rows per request — is pure host waste
             slot.buf[n:] = 0
+        if cache_key is not None and self.cache is not None \
+                and self.ragged:
+            # ragged entries are host row extents, copied out of the
+            # slot while its rows are still live (pre-handoff)
+            with hostprof.section("loader.cache_insert"):
+                self.cache.insert_rows(cache_key, slot.buf, n)
         self.staging.begin_transfer(slot)
         if self._trace_step is not None:
             _record_clamped(time_card,
@@ -784,16 +1025,17 @@ class R2P1DLoader(StageModel):
                             "transfer%d_done" % self._trace_step,
                             time.time())
         self._release_handle_slot(handle)
-        if cache_key is not None and self.cache is not None:
+        if cache_key is not None and self.cache is not None \
+                and not self.ragged:
             # still zero-copy: the cached device array owns its bytes
             # once the transfer is confirmed; the slot recycle gate
             # (and the alias probe behind it) guarantees exactly that
             with hostprof.section("loader.cache_insert"):
                 self.cache.insert_device(cache_key, device_u8, n)
-        if self._preprocess is None:
-            return (PaddedBatch(device_u8, n),), None, time_card
-        return (PaddedBatch(self._preprocess(device_u8), n),), None, \
-            time_card
+        self._note_emission_padding(n, int(device_u8.shape[0]),
+                                    [time_card])
+        return (self._wrap_batch(self._normalize_emission(device_u8, n),
+                                 n),), None, time_card
 
     def complete(self, handle: _DecodeHandle, non_tensors, time_card):
         """Wait for a submitted decode, then pad/transfer/normalize
@@ -992,7 +1234,15 @@ class R2P1DFusingLoader(R2P1DLoader):
         """Executor protocol (rnb_tpu.runner): drive this stage's
         hold deadline / accumulation target with a BatchController
         over the stage's own warmed bucket set — decisions can only
-        name shapes warm-up already compiled."""
+        name shapes warm-up already compiled. Under ragged dispatch
+        every row count hits the same executable, so the candidate
+        set is continuous (1..pool_rows): hold/batch decisions stop
+        being quantized to the warmed-bucket vocabulary."""
+        if self.ragged:
+            self.autotune = BatchController.for_stage(
+                settings, tuple(range(1, self.pool_rows + 1)),
+                self.pool_rows)
+            return self.autotune
         self.autotune = BatchController.for_stage(
             settings, self.row_buckets, self.max_clips)
         return self.autotune
@@ -1215,13 +1465,22 @@ class R2P1DFusingLoader(R2P1DLoader):
         if not ok:
             return True
         rows = sum(rec.handle.n for rec in ok)
-        bucket = self._bucket_for(rows)
+        # under ragged the emission ships the ONE pool shape with an
+        # explicit rows_valid; the segment table maps each constituent
+        # request to its row range
+        bucket = self.pool_rows if self.ragged else \
+            self._bucket_for(rows)
+        offsets = None
+        if self.ragged:
+            offsets = segment_offsets_of(rec.handle.n for rec in ok)
         if self.autotune is not None:
             # every batched emission is attributed to its shipped
-            # bucket; emissions with no preceding decision (forced
-            # drains) are back-filled as immediate decisions so the
-            # --check invariant decisions >= emissions holds
-            self.autotune.note_emission(bucket)
+            # bucket (the actual row count under ragged, where every
+            # count is a legal dispatch); emissions with no preceding
+            # decision (forced drains) are back-filled as immediate
+            # decisions so the --check invariant decisions >=
+            # emissions holds
+            self.autotune.note_emission(rows if self.ragged else bucket)
         # service-span origin for the autotune estimator: the batch
         # just closed (stopped accumulating); everything from here to
         # the emission landing on the ready queue — assemble, cache
@@ -1250,19 +1509,28 @@ class R2P1DFusingLoader(R2P1DLoader):
         out, slot = self._assemble(ok, rows, bucket)
         if self.cache is not None:
             # insert-after-success: only decodes that reached this
-            # point populate the cache. insert_host copies the rows
-            # out of the slot BEFORE the transfer/recycle below, so a
-            # cached entry can never alias recycled staging memory.
+            # point populate the cache. Both insert flavors copy the
+            # rows out of the slot BEFORE the transfer/recycle below,
+            # so a cached entry can never alias recycled staging
+            # memory. Ragged entries are host row extents (exactly n
+            # rows, no bucket padding, no insert-time device_put —
+            # hits re-enter the pool fill); bucketed entries stay the
+            # padded device batch hits serve zero-copy.
             with hostprof.section("loader.cache_insert"):
                 for rec in ok:
                     if rec.key is not None:
                         n = rec.handle.n
-                        self.cache.insert_host(
-                            rec.key, rec.handle.out, n,
-                            self._batch_shape(self._bucket_for(n)))
+                        if self.ragged:
+                            self.cache.insert_rows(rec.key,
+                                                   rec.handle.out, n)
+                        else:
+                            self.cache.insert_host(
+                                rec.key, rec.handle.out, n,
+                                self._batch_shape(self._bucket_for(n)))
         cards = []
         for rec in ok:
             cards.extend(rec.cards)
+        self._note_emission_padding(rows, bucket, cards)
         if slot is not None:
             # the taken rows are consumed once the transfer below
             # confirms; the begin/finish_transfer hold keeps the slot
@@ -1270,14 +1538,23 @@ class R2P1DFusingLoader(R2P1DLoader):
             self.staging.begin_transfer(slot)
             for rec in ok:
                 self._release_handle_slot(rec.handle)
+        # the controller's service estimator is keyed by the same
+        # vocabulary its decisions use: the shipped bucket — or, under
+        # ragged, the VALID row count (every emission ships the pool
+        # shape, but with a chunked network body the real service
+        # scales with valid rows; keying all samples at pool_rows
+        # would blend every candidate's estimate into one EWMA)
+        service_key = rows if self.ragged else bucket
         if self._worker is not None:
             # pipelined handoff: the worker transfers batch N while
             # this thread plans/harvests batch N+1
             self._worker.submit(
                 lambda: self._transfer_job(out, slot, rows, cards,
-                                           bucket, t_close))
+                                           service_key, t_close,
+                                           offsets))
             return True
-        self._transfer_sync(out, slot, rows, cards, bucket, t_close)
+        self._transfer_sync(out, slot, rows, cards, service_key,
+                            t_close, offsets)
         return True
 
     def _min_live_row(self, slot) -> int:
@@ -1319,9 +1596,11 @@ class R2P1DFusingLoader(R2P1DLoader):
                 # (hold-timeout) take left batchmates in flight
                 staged = False
             if staged:
-                if bucket > rows:
+                if bucket > rows and not self.ragged:
                     with hostprof.section("loader.emit_copy"):
-                        # seed byte parity: padding rows stay zeroed
+                        # seed byte parity: padding rows stay zeroed.
+                        # Under ragged the consumer's kernel masks the
+                        # pool tail, so the memset is skipped
                         slot.buf[rows:bucket] = 0
                 self.staging.note_staged()
                 return slot.buf[:bucket], slot
@@ -1335,7 +1614,9 @@ class R2P1DFusingLoader(R2P1DLoader):
                 n = rec.handle.n
                 out[row:row + n] = rec.handle.out[:n]
                 row += n
-            if row < out.shape[0]:
+            if row < out.shape[0] and not self.ragged:
+                # ragged consumers mask the pool tail in-jit; only the
+                # bucketed path needs zeroed padding bytes
                 out[row:] = 0
         for rec in ok:
             # rows copied out: slot references retire immediately
@@ -1345,7 +1626,8 @@ class R2P1DFusingLoader(R2P1DLoader):
         return out, None
 
     def _transfer_sync(self, out, slot, rows: int, cards,
-                       bucket: int, t_close: float) -> None:
+                       bucket: int, t_close: float,
+                       offsets=None) -> None:
         """Inline transfer on the executor thread (transfer_async
         off): the seed path minus the assembly — the transfer is
         confirmed lazily at the slot's next acquire, so the executor
@@ -1361,15 +1643,17 @@ class R2P1DFusingLoader(R2P1DLoader):
             for tc in cards:
                 _record_clamped(tc, "transfer%d_done" % self._trace_step,
                                 at)
-        if self._preprocess is not None:
+        if self._preprocess is not None or \
+                self._preprocess_ragged is not None:
             with hostprof.section("loader.preprocess_dispatch"):
-                batch = self._preprocess(batch)
-        self._push_ready(((PaddedBatch(batch, rows),), None,
-                          TimeCardList(cards)),
+                batch = self._normalize_emission(batch, rows)
+        self._push_ready(((self._wrap_batch(batch, rows, offsets),),
+                          None, TimeCardList(cards)),
                          bucket, time.monotonic() - t_close)
 
     def _transfer_job(self, out, slot, rows: int, cards,
-                      bucket: int, t_close: float) -> None:
+                      bucket: int, t_close: float,
+                      offsets=None) -> None:
         """Transfer-worker body: issue the device_put for batch N
         while the executor decodes batch N+1 into the next slot;
         confirm completion (alias-probed) before releasing the slot's
@@ -1386,11 +1670,12 @@ class R2P1DFusingLoader(R2P1DLoader):
             for tc in cards:
                 _record_clamped(tc, "transfer%d_done" % self._trace_step,
                                 at)
-        if self._preprocess is not None:
+        if self._preprocess is not None or \
+                self._preprocess_ragged is not None:
             with hostprof.section("transfer.preprocess_dispatch"):
-                batch = self._preprocess(batch)
-        self._push_ready(((PaddedBatch(batch, rows),), None,
-                          TimeCardList(cards)),
+                batch = self._normalize_emission(batch, rows)
+        self._push_ready(((self._wrap_batch(batch, rows, offsets),),
+                          None, TimeCardList(cards)),
                          bucket, time.monotonic() - t_close)
 
     def _push_ready(self, emission, bucket=None,
@@ -1522,6 +1807,37 @@ class R2P1DFusingLoader(R2P1DLoader):
     def __call__(self, tensors, non_tensors, time_card):
         video = str(non_tensors)
         key, entry = self._cache_lookup(video)
+        if entry is not None and self.ragged:
+            # ragged hit: the cached HOST row extent fills its pool
+            # rows like a decode that completed instantly — it rides
+            # the next fused emission (one pool transfer for hits and
+            # misses alike) instead of dispatching standalone. The
+            # decode is skipped; the memcpy into the slot slice is the
+            # whole cost.
+            n = entry.valid
+            time_card.num_clips = n
+            time_card.cache_hit = True
+            if self.ragged_stats is not None:
+                self.ragged_stats["cache_hit_rows"] += n
+            target, hit_slot, hit_row0 = self._stage_target(n)
+            np.copyto(target, entry.batch[:n])
+            handle = _DecodeHandle(target, n, slot=hit_slot,
+                                   row0=hit_row0)
+            self._stamp_decode_done(time_card)
+            if self.autotune is not None:
+                self.autotune.observe_rows(n)
+            rec = _FuseRecord(handle, video, time_card, key=None)
+            # join the in-flight window IN ARRIVAL ORDER (the handle
+            # is already complete, so harvest promotes it at its FIFO
+            # turn): jumping straight to _ready would reorder the
+            # slot's planned row ranges and force every such take off
+            # the zero-copy staged path onto the assembly-copy
+            # fallback
+            self._inflight.append(rec)
+            out = self.poll()
+            if out is not None:
+                return out
+            return None, None, None
         if entry is not None:
             # hit: serve from the device-resident entry right now — no
             # decode, no transfer, no fuse wait
@@ -1646,6 +1962,12 @@ class R2P1DRunner(StageModel):
     warm-up compiles the exact shape.
     """
 
+    #: dispatches can arrive as a flat row pool at ONE compiled shape
+    #: (RaggedBatch) — the stage then warms exactly one executable and
+    #: its yuv420 fused ingest masks the pool tail via the ragged
+    #: primitive (root 'ragged' config key, rnb_tpu.ops.ragged)
+    SUPPORTS_RAGGED = True
+
     def __init__(self, device, start_index: int = 1,
                  end_index: int = NUM_LAYERS,
                  num_classes: int = KINETICS_CLASSES,
@@ -1655,7 +1977,9 @@ class R2P1DRunner(StageModel):
                  num_warmups: int = NUM_WARMUPS,
                  ckpt_path: Optional[str] = None,
                  row_buckets=None, factored_shortcut: bool = False,
-                 pixel_path: str = "rgb", **kwargs):
+                 pixel_path: str = "rgb",
+                 ragged: bool = False, ragged_pool_rows=None,
+                 ragged_chunk_rows=None, **kwargs):
         super().__init__(device)
         import jax
         if not (1 <= start_index <= end_index <= NUM_LAYERS):
@@ -1673,6 +1997,32 @@ class R2P1DRunner(StageModel):
         self.end_index = int(end_index)
         self.max_rows = int(max_rows)
         self.pixel_path = pixel_path
+        # Ragged row-pool dispatch (rnb_tpu.ops.ragged): the stage's
+        # input is always the ONE pool shape (== the declared max row
+        # axis) plus a traced rows_valid scalar — one warmup compile
+        # covers every batch composition, and for yuv420 the fused
+        # ingest's Pallas grid skip spends no arithmetic on pad rows.
+        self.ragged = bool(ragged)
+        self.pool_rows = (resolve_pool_rows(ragged_pool_rows,
+                                            self.max_rows, "max_rows")
+                          if self.ragged else None)
+        # the ragged applier's dynamic row-tile grid: None = auto
+        # (default_ragged_chunk), 0 = whole-pool apply, else a divisor
+        # of the pool capacity
+        self.ragged_chunk_rows = 0
+        if self.ragged:
+            if ragged_chunk_rows is None:
+                self.ragged_chunk_rows = default_ragged_chunk(
+                    self.pool_rows)
+            else:
+                self.ragged_chunk_rows = int(ragged_chunk_rows)
+                if self.ragged_chunk_rows < 0 or (
+                        self.ragged_chunk_rows
+                        and self.pool_rows % self.ragged_chunk_rows):
+                    raise ValueError(
+                        "ragged_chunk_rows=%r must be 0 (whole-pool "
+                        "apply) or a positive divisor of pool_rows=%d"
+                        % (ragged_chunk_rows, self.pool_rows))
         layer_sizes = tuple(layer_sizes)
         self._jax_device = _resolve(device)
         # factored_shortcut matches converted reference checkpoints
@@ -1680,7 +2030,9 @@ class R2P1DRunner(StageModel):
         self._apply = _shared_apply(self.start_index, self.end_index,
                                     num_classes, layer_sizes,
                                     bool(factored_shortcut),
-                                    pixel_path=pixel_path)
+                                    pixel_path=pixel_path,
+                                    ragged=self.ragged,
+                                    ragged_chunk=self.ragged_chunk_rows)
         self._variables = _shared_params(self.start_index, self.end_index,
                                          num_classes, layer_sizes,
                                          ckpt_path, self._jax_device,
@@ -1700,15 +2052,39 @@ class R2P1DRunner(StageModel):
         warm_dtype = getattr(jnp, self.input_dtype_for(
             start_index=self.start_index, pixel_path=self.pixel_path))
         # match the loader's row bucketing: compile one executable per
-        # bucket row count so no compile lands in the measured window
-        warm_rows = _normalize_row_buckets(row_buckets, self.max_rows,
-                                           "max_rows")
+        # bucket row count so no compile lands in the measured window.
+        # Under ragged dispatch the warmup matrix collapses to the ONE
+        # pool shape — any row_buckets in the config are the bucketed
+        # counterfactual, never warmed shapes — which is exactly what
+        # the Compiles: accounting asserts at runtime.
+        if self.ragged:
+            warm_rows = (self.pool_rows,)
+        else:
+            warm_rows = _normalize_row_buckets(row_buckets,
+                                               self.max_rows,
+                                               "max_rows")
+        #: jit-entry signature accounting (rnb_tpu.compilestats):
+        #: distinct applier input signatures == executables this stage
+        #: requires; frozen by the executor at measured-window start
+        self.compiles = SignatureTracker()
         for rows in warm_rows:
-            dummy = jax.device_put(
-                np.zeros((rows,) + self._steady_shape[1:], warm_dtype),
-                self._jax_device)
-            for _ in range(num_warmups):
-                jax.block_until_ready(self._apply(self._variables, dummy))
+            host = np.zeros((rows,) + self._steady_shape[1:],
+                            warm_dtype)
+            # the declared shape vocabulary is observed even under
+            # num_warmups=0 (warmup explicitly opted out): the
+            # steady_new accounting flags OUT-OF-VOCABULARY
+            # signatures — drift — not the expected first-call
+            # compile of an unwarmed run
+            self.compiles.observe(host)
+            if num_warmups > 0:
+                dummy = jax.device_put(host, self._jax_device)
+                for _ in range(num_warmups):
+                    if self.ragged:
+                        jax.block_until_ready(self._apply(
+                            self._variables, dummy, np.int32(rows)))
+                    else:
+                        jax.block_until_ready(
+                            self._apply(self._variables, dummy))
 
     def input_shape(self):
         return (self._steady_shape,)
@@ -1779,7 +2155,19 @@ class R2P1DRunner(StageModel):
         jax, _ = _jax_numpy()
         pb = tensors[0]
         x = jax.device_put(pb.data, self._jax_device)
-        out = self._apply(self._variables, x)
+        self.compiles.observe(x)
+        if self.ragged:
+            out = self._apply(self._variables, x, np.int32(pb.valid))
+        else:
+            out = self._apply(self._variables, x)
+        if self.ragged:
+            # the pool shape rides through: downstream consumers (and
+            # the executor's payload validation) see the same segment
+            # table the loader filled
+            offsets = getattr(pb, "segment_offsets",
+                              (0, int(pb.valid)))
+            return (RaggedBatch(out, pb.valid, offsets),), \
+                non_tensors, time_card
         return (PaddedBatch(out, pb.valid),), non_tensors, time_card
 
 

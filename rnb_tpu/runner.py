@@ -60,7 +60,8 @@ from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
                              dispose_requests, send_exit_markers)
 from rnb_tpu.devices import DeviceSpec
 from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
-from rnb_tpu.stage import PaddedBatch
+from rnb_tpu.ops.ragged import check_segment_offsets
+from rnb_tpu.stage import PaddedBatch, RaggedBatch
 from rnb_tpu.telemetry import TimeCardList, TimeCardSummary, logname
 from rnb_tpu.utils.class_utils import load_class
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
@@ -159,6 +160,17 @@ class RunnerContext:
     #: controller-owning stages append their final decision/deadline
     #: counters here (BenchmarkResult + log-meta `Autotune:` line)
     autotune_sink: Optional[List] = None
+    #: every stage appends ``(step_idx, warmup_s, sigs-or-None)`` here:
+    #: construction wall time plus — for stages owning a jit applier —
+    #: the SignatureTracker snapshot (rnb_tpu.compilestats), feeding
+    #: the `Compiles:`/`Warmup:` log-meta lines
+    compile_sink: Optional[List] = None
+    #: batching stages append their PadCounter snapshot here
+    #: (BenchmarkResult pad_rows/total_rows + log-meta `Padding:` line)
+    pad_sink: Optional[List] = None
+    #: ragged stages (root 'ragged' config key) append their
+    #: ragged_stats here (BenchmarkResult ragged_* + `Ragged:` line)
+    ragged_sink: Optional[List] = None
     #: per-job rnb_tpu.trace.Tracer when the config's `trace` key
     #: enabled tracing, else None. The executor emits hot-loop spans
     #: through the module-level trace hooks (one None test when off),
@@ -245,6 +257,15 @@ def validate_payload(declared, payload, where: str) -> None:
                 "%s output %d has shape %r but declares %r (row axis may "
                 "be smaller under bucketing, never larger; trailing dims "
                 "must match exactly)" % (where, idx, got, want))
+        if isinstance(pb, RaggedBatch):
+            # ragged payloads additionally carry a per-request segment
+            # table that must partition the valid rows — a broken pool
+            # fill fails here, at the producing step, not as garbage
+            # logits downstream
+            try:
+                check_segment_offsets(pb.segment_offsets, pb.valid)
+            except ValueError as e:
+                raise ValueError("%s output %d: %s" % (where, idx, e))
 
 
 def _cards_of(time_card) -> list:
@@ -327,9 +348,16 @@ def runner(ctx: RunnerContext) -> None:
     progress_bar = None
     declared_shapes = None
     controller = None
+    warmup_s = 0.0
     try:
         model_class = load_class(ctx.model_class_path)
+        # warmup wall time: weights + warmup compiles all happen in the
+        # stage constructor, before the start barrier — the launch cost
+        # the `Warmup:` accounting surfaces (ragged collapses the
+        # per-bucket compile matrix here)
+        t_construct = time.monotonic()
         model = model_class(ctx.device, **ctx.model_kwargs)
+        warmup_s = time.monotonic() - t_construct
         declared_shapes = model_class.output_shape_for(**ctx.model_kwargs)
 
         selector = None
@@ -359,6 +387,13 @@ def runner(ctx: RunnerContext) -> None:
         ctx.sta_bar.wait()
     except threading.BrokenBarrierError:
         pass
+    # the measured window opens here: any jit-entry signature the
+    # stage's applier first sees from now on is a mid-run recompile
+    # (surfaced as steady_new in the Compiles: accounting; parse_utils
+    # --check fails on nonzero)
+    compile_tracker = getattr(model, "compiles", None)
+    if compile_tracker is not None:
+        compile_tracker.freeze()
 
     if ctx.print_progress:
         try:
@@ -696,9 +731,15 @@ def runner(ctx: RunnerContext) -> None:
                     if t_fin is not None:
                         t_sta = max(tc.timings.get(key_inf_start, t_fin)
                                     for tc in cards)
+                        out_pb = tensors_out[0]
+                        # ragged emissions always ship the pool shape;
+                        # the controller's continuous candidates are
+                        # keyed by the VALID rows the dispatch carried
+                        rows_key = (out_pb.valid
+                                    if isinstance(out_pb, RaggedBatch)
+                                    else int(out_pb.data.shape[0]))
                         controller.observe_service(
-                            int(tensors_out[0].data.shape[0]),
-                            max(0.0, t_fin - t_sta))
+                            rows_key, max(0.0, t_fin - t_sta))
 
                 out_queue = None
                 if ctx.out_queues is not None:
@@ -892,6 +933,30 @@ def runner(ctx: RunnerContext) -> None:
         if ctx.autotune_sink is not None and controller is not None:
             try:
                 ctx.autotune_sink.append(controller.snapshot())
+            except Exception:
+                traceback.print_exc()
+        # compile/warmup accounting: every stage reports construction
+        # time; jit-owning stages add their signature snapshot
+        if ctx.compile_sink is not None and model is not None:
+            try:
+                tracker = getattr(model, "compiles", None)
+                ctx.compile_sink.append(
+                    (ctx.step_idx, warmup_s,
+                     tracker.snapshot() if tracker is not None
+                     else None))
+            except Exception:
+                traceback.print_exc()
+        # padding-waste counters (bucketed) / ragged pool counters
+        if (ctx.pad_sink is not None
+                and getattr(model, "padding", None) is not None):
+            try:
+                ctx.pad_sink.append(model.padding.snapshot())
+            except Exception:
+                traceback.print_exc()
+        if (ctx.ragged_sink is not None
+                and getattr(model, "ragged_stats", None) is not None):
+            try:
+                ctx.ragged_sink.append(dict(model.ragged_stats))
             except Exception:
                 traceback.print_exc()
         try:

@@ -24,6 +24,66 @@ import numpy as np
 
 
 @dataclasses.dataclass
+class PadCounter:
+    """Padding-waste accounting for one batching stage instance.
+
+    Every emission notes its valid rows and the rows it actually
+    shipped; the difference is pad work the downstream stage burns
+    FLOPs (and the wire burns bytes) on. Surfaced end-to-end —
+    BenchmarkResult ``pad_rows``/``total_rows``, the log-meta
+    ``Padding:`` line, the ``# padding`` table trailer — so the
+    bucketed path quantifies the waste the ragged path removes (and
+    the ragged path proves its computed-pad count is ~0).
+    """
+
+    pad_rows: int = 0
+    total_rows: int = 0
+    emissions: int = 0
+
+    def note(self, valid: int, shipped: int) -> int:
+        """Record one emission; returns its pad-row count."""
+        pad = max(0, int(shipped) - int(valid))
+        self.pad_rows += pad
+        self.total_rows += int(shipped)
+        self.emissions += 1
+        return pad
+
+    def snapshot(self) -> dict:
+        return {"pad_rows": self.pad_rows, "total_rows": self.total_rows,
+                "emissions": self.emissions}
+
+
+def note_emission_accounting(padding: "PadCounter", ragged_stats,
+                             cards, valid: int, shipped: int,
+                             counterfactual_rows: int) -> None:
+    """The ONE padding/ragged accounting rule every batching stage
+    (loaders, Batcher) applies per emission — parse_utils --check
+    asserts invariants over these counters, so two hand-maintained
+    copies would be exactly the drift the checker exists to stop.
+
+    Bucketed (``ragged_stats is None``): count ``shipped - valid`` pad
+    rows. Ragged: the consumer's kernel computes no pad rows, so the
+    counted shipped rows ARE the valid rows and ``counterfactual_rows
+    - valid`` — what the bucketed pad rule would have shipped — lands
+    in ``pad_rows_eliminated`` (equal to a same-seed bucketed arm's
+    ``pad_rows`` by construction). Either way the emission's pad count
+    is stamped on the FIRST constituent card (0 on the rest) so table
+    sums stay exact.
+    """
+    if ragged_stats is not None:
+        pad = padding.note(valid, valid)
+        ragged_stats["emissions"] += 1
+        ragged_stats["rows"] += valid
+        ragged_stats["pad_rows_eliminated"] += \
+            int(counterfactual_rows) - int(valid)
+    else:
+        pad = padding.note(valid, shipped)
+    for idx, tc in enumerate(cards):
+        tc.pad_rows = (getattr(tc, "pad_rows", 0) + pad if idx == 0
+                       else getattr(tc, "pad_rows", 0))
+
+
+@dataclasses.dataclass
 class PaddedBatch:
     """A static-shape array plus the number of leading valid rows.
 
@@ -60,6 +120,32 @@ class PaddedBatch:
             return PaddedBatch(rows, n)
         pad = np.zeros((max_rows - n,) + rows.shape[1:], dtype=rows.dtype)
         return PaddedBatch(np.concatenate([rows, pad], axis=0), n)
+
+
+@dataclasses.dataclass
+class RaggedBatch(PaddedBatch):
+    """A :class:`PaddedBatch` whose row axis is a **flat row pool** at
+    the stage's one compiled shape, plus the per-request segment table
+    (rnb_tpu.ops.ragged).
+
+    ``data`` always has exactly the pool shape — never a bucket —
+    so every dispatch hits the same XLA executable; ``valid`` is the
+    scalar ``rows_valid`` the ragged forward primitive masks against;
+    ``segment_offsets`` partitions ``[0, valid)`` per constituent
+    request (request i owns rows ``[offsets[i], offsets[i+1])``),
+    validated by the executor on every publish.
+    """
+
+    segment_offsets: Tuple[int, ...] = (0, 0)
+
+    def __post_init__(self):
+        self.segment_offsets = tuple(int(o)
+                                     for o in self.segment_offsets)
+
+    @property
+    def num_segments(self) -> int:
+        """Constituent requests packed into the pool."""
+        return len(self.segment_offsets) - 1
 
 
 def normalize_row_buckets(row_buckets, max_rows: int, what: str
@@ -145,6 +231,13 @@ class StageModel:
     #: their accumulate/emit decisions through the controller. The
     #: executor and the static graph checker both key off this.
     SUPPORTS_AUTOTUNE = False
+
+    #: True for stages that implement the ragged row-pool dispatch
+    #: contract (root 'ragged' config key, rnb_tpu.ops.ragged): they
+    #: accept ``ragged``/``ragged_pool_rows`` constructor kwargs, warm
+    #: exactly ONE shape (the pool), and move RaggedBatch payloads.
+    #: The launcher injects the kwargs only for supporting classes.
+    SUPPORTS_RAGGED = False
 
     def __init__(self, device, **kwargs):
         self.device = device

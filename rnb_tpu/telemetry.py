@@ -64,7 +64,13 @@ def latency_percentiles(latencies_ms: Sequence[float],
 #: fork/merge: clip count (routing, MFU accounting) and the cache
 #: outcome (rnb_tpu.cache: True=hit, False=miss; cache_coalesced marks
 #: a request that shared another request's in-flight decode)
-CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced")
+CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced",
+                  # pad rows the emission carrying this request shipped
+                  # (attributed to the emission's first constituent so
+                  # sums stay exact; 0 on every other card and on every
+                  # ragged emission — the ragged kernel computes no pad
+                  # rows)
+                  "pad_rows")
 
 
 # -- the declared telemetry schema ------------------------------------
@@ -141,6 +147,20 @@ META_LINE_REGISTRY = (
     StampSpec("Autotune buckets:", "rnb_tpu/benchmark.py",
               "JSON per-chosen-bucket emission counts "
               "(autotune-enabled runs only)"),
+    StampSpec("Ragged:", "rnb_tpu/benchmark.py",
+              "ragged row-pool dispatch counters: pool capacity, "
+              "emissions, valid rows, pad rows the bucketed rule "
+              "would have shipped (ragged-enabled runs only)"),
+    StampSpec("Padding:", "rnb_tpu/benchmark.py",
+              "bucketed-path padding waste: pad rows / total shipped "
+              "rows / emissions summed over batching stages"),
+    StampSpec("Compiles:", "rnb_tpu/benchmark.py",
+              "JSON per-step jit-entry signature counts "
+              "{step: {warmup, steady_new, steady_calls}} — "
+              "steady_new > 0 means a mid-run recompile"),
+    StampSpec("Warmup:", "rnb_tpu/benchmark.py",
+              "JSON per-step stage-construction wall seconds "
+              "(weights + warmup compiles)"),
     StampSpec("Trace:", "rnb_tpu/benchmark.py",
               "trace-export counters: events written to trace.json, "
               "events dropped at the max_events cap "
@@ -161,6 +181,9 @@ TABLE_TRAILER_REGISTRY = (
     StampSpec("phases", "rnb_tpu/telemetry.py",
               "per-instance per-phase latency attribution "
               "(mean/p99 microseconds; trace-enabled runs only)"),
+    StampSpec("padding", "rnb_tpu/telemetry.py",
+              "per-instance pad rows shipped with completed requests "
+              "(0 under ragged dispatch)"),
 )
 
 
@@ -437,6 +460,12 @@ class TimeCardSummary:
         self.num_cache_hits: int = 0
         self.num_cache_coalesced: int = 0
         self.num_cache_tracked: int = 0
+        # padding-waste attribution: pad rows the emissions carrying
+        # the registered completions shipped (stamped on each
+        # emission's first constituent card by the batching stages;
+        # tracked=0 keeps pre-padding-era reports byte-stable)
+        self.num_pad_rows: int = 0
+        self.num_pad_tracked: int = 0
         # per-request phase attribution (rnb_tpu.trace): surfaced as a
         # `# phases` trailer + the job-wide `Phases:` line ONLY when
         # the executor opts this summary in (trace-enabled runs) —
@@ -484,6 +513,10 @@ class TimeCardSummary:
                 self.num_cache_hits += 1
         if getattr(time_card, "cache_coalesced", False):
             self.num_cache_coalesced += 1
+        pad = getattr(time_card, "pad_rows", None)
+        if pad is not None:
+            self.num_pad_tracked += 1
+            self.num_pad_rows += int(pad)
 
     def total_clips(self) -> int:
         """Sum of registered records' ``num_clips`` stamps."""
@@ -563,6 +596,16 @@ class TimeCardSummary:
                 % (self.num_cache_hits, self.num_cache_coalesced,
                    self.num_cache_tracked))
 
+    def padding_line(self) -> Optional[str]:
+        """The ``# padding ...`` trailer, or None when no registered
+        card carried a ``pad_rows`` stamp (pre-padding-era pipelines
+        keep their byte-stable reports). pad_rows=0 on a tracked run
+        is a result — exactly what a ragged arm should show."""
+        if not self.num_pad_tracked:
+            return None
+        return ("# padding pad_rows=%d num_tracked=%d"
+                % (self.num_pad_rows, self.num_pad_tracked))
+
     def phase_samples(self, num_skips: int = 0):
         """{phase: [per-request milliseconds]} over records after
         ``num_skips`` — the deterministic stamp-only decomposition
@@ -636,6 +679,9 @@ class TimeCardSummary:
         cache = self.cache_line()
         if cache is not None:
             fp.write(cache + "\n")
+        padding = self.padding_line()
+        if padding is not None:
+            fp.write(padding + "\n")
         phases = self.phases_line()
         if phases is not None:
             fp.write(phases + "\n")

@@ -88,6 +88,32 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["autotune_" + key] = int(val)
+        elif line.startswith("Ragged:"):
+            # "Ragged: pool_rows=P emissions=E rows=R
+            #  pad_rows_eliminated=K cache_hit_rows=H" — written only
+            # by ragged-enabled runs (rnb_tpu.ops.ragged)
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["ragged_" + key] = int(val)
+        elif line.startswith("Padding:"):
+            # "Padding: pad_rows=P total_rows=T pad_emissions=E" —
+            # padding-waste counters over every batching stage
+            # (rnb_tpu.stage.PadCounter); ~0 pad_rows under ragged
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta[key] = int(val)
+        elif line.startswith("Compiles:"):
+            # JSON {step: {warmup, steady_new, steady_calls}} —
+            # jit-entry signature accounting (rnb_tpu.compilestats);
+            # steady_new > 0 is a mid-run recompile (--check fails it)
+            import json
+            meta["compile_signatures"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Warmup:"):
+            # JSON {step: seconds} — per-step stage-construction wall
+            # time (weights + warmup compiles)
+            import json
+            meta["warmup_s"] = json.loads(line.split(":", 1)[1])
         elif line.startswith("Trace:"):
             # "Trace: events=N dropped=M" — written only by
             # trace-enabled runs (rnb_tpu.trace); counts events
@@ -493,6 +519,8 @@ def check_job(job_dir: str) -> List[str]:
     table_faults = {"num_failed": 0, "num_shed": 0, "num_retries": 0}
     cache_hits = cache_tracked = 0
     saw_cache_trailer = False
+    trailer_pads = 0
+    saw_pad_trailer = False
     for path in tables:
         try:
             num_rows += len(parse_timing_table(path))
@@ -507,6 +535,9 @@ def check_job(job_dir: str) -> List[str]:
             saw_cache_trailer = True
             cache_hits += trailers["cache"].get("num_hits", 0)
             cache_tracked += trailers["cache"].get("num_tracked", 0)
+        if "padding" in trailers:
+            saw_pad_trailer = True
+            trailer_pads += trailers["padding"].get("pad_rows", 0)
     if not tables:
         problems.append("no timing tables (<device>-group<g>-<i>.txt)")
 
@@ -631,16 +662,89 @@ def check_job(job_dir: str) -> List[str]:
                 "autotune_deadline_us_sum=%d with autotune_held=0 "
                 "(only held decisions enter the deadline histogram)"
                 % d_sum)
-        configured = _configured_buckets(job_dir)
-        if buckets and configured:
-            rogue = sorted(int(b) for b in buckets
-                           if int(b) not in configured)
+        if "ragged_pool_rows" in meta:
+            # ragged dispatch: every row count <= pool_rows hits the
+            # same executable, so the warmed-set subset rule relaxes
+            # to the pool capacity (decisions are continuous)
+            pool = meta["ragged_pool_rows"]
+            rogue = sorted(int(b) for b in buckets if int(b) > pool)
             if rogue:
                 problems.append(
-                    "autotune chose row bucket(s) %s the config never "
-                    "warms (configured: %s) — each would have been a "
-                    "silent mid-run recompile"
-                    % (rogue, sorted(configured)))
+                    "autotune chose row count(s) %s above the ragged "
+                    "pool capacity %d" % (rogue, pool))
+        else:
+            configured = _configured_buckets(job_dir)
+            if buckets and configured:
+                rogue = sorted(int(b) for b in buckets
+                               if int(b) not in configured)
+                if rogue:
+                    problems.append(
+                        "autotune chose row bucket(s) %s the config "
+                        "never warms (configured: %s) — each would "
+                        "have been a silent mid-run recompile"
+                        % (rogue, sorted(configured)))
+
+    # padding-waste accounting (rnb_tpu.stage.PadCounter): pads are a
+    # subset of shipped rows, and the per-instance trailers (final-step
+    # completions only) can never exceed the job-wide meta counters
+    if "pad_rows" in meta:
+        if meta["pad_rows"] > meta.get("total_rows", 0):
+            problems.append(
+                "pad_rows=%d exceeds total_rows=%d (pads are part of "
+                "the shipped rows)" % (meta["pad_rows"],
+                                       meta.get("total_rows", 0)))
+        if saw_pad_trailer and trailer_pads > meta["pad_rows"]:
+            problems.append(
+                "tables count pad_rows=%d but log-meta says %d "
+                "(the job-wide counter covers every emission)"
+                % (trailer_pads, meta["pad_rows"]))
+
+    # ragged row-pool accounting (rnb_tpu.ops.ragged): every emission
+    # ships the one pool shape, so valid rows are bounded by
+    # emissions * pool_rows; counters never go negative
+    if "ragged_emissions" in meta:
+        for key in ("ragged_pool_rows", "ragged_emissions",
+                    "ragged_rows", "ragged_pad_rows_eliminated",
+                    "ragged_cache_hit_rows"):
+            if meta.get(key, 0) < 0:
+                problems.append("negative %s" % key)
+        if meta.get("ragged_rows", 0) > (meta.get("ragged_emissions", 0)
+                                         * meta.get("ragged_pool_rows",
+                                                    0)):
+            problems.append(
+                "ragged_rows=%d exceeds emissions*pool_rows=%d — an "
+                "emission carried more valid rows than the pool holds"
+                % (meta.get("ragged_rows", 0),
+                   meta.get("ragged_emissions", 0)
+                   * meta.get("ragged_pool_rows", 0)))
+        if meta.get("ragged_cache_hit_rows", 0) \
+                > meta.get("ragged_rows", 0):
+            problems.append(
+                "ragged_cache_hit_rows=%d exceeds ragged_rows=%d "
+                "(hit rows ship inside pool emissions)"
+                % (meta["ragged_cache_hit_rows"], meta["ragged_rows"]))
+        # ragged emissions compute no pad rows: the Padding: counter
+        # must stay 0 for a ragged-only pipeline (mixed pipelines may
+        # carry bucketed stages, so only flag when every batching
+        # stage is ragged — emissions counts agree exactly then)
+        if meta.get("pad_emissions") == meta.get("ragged_emissions") \
+                and meta.get("pad_rows", 0) > 0:
+            problems.append(
+                "pad_rows=%d on a fully ragged run (every emission "
+                "ragged) — the ragged path must compute no pad rows"
+                % meta["pad_rows"])
+
+    # compile/warmup accounting (rnb_tpu.compilestats): a jit-entry
+    # signature first seen inside the measured window is a silent
+    # mid-run XLA recompile — the dynamic twin of rnb-lint RNB-G006
+    for step, sigs in sorted(dict(meta.get("compile_signatures",
+                                           {})).items()):
+        if int(sigs.get("steady_new", 0)) > 0:
+            problems.append(
+                "%s compiled %d new signature(s) inside the measured "
+                "window (Compiles: steady_new) — warmup must cover "
+                "the full shape vocabulary"
+                % (step, int(sigs["steady_new"])))
 
     # phase attribution (rnb_tpu.trace): the stamp-only decomposition
     # must partition every request's end-to-end span, cover every

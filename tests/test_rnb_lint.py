@@ -39,6 +39,7 @@ GRAPH_CASES = [
     ("bad_g006_autotune.json", "RNB-G006"),
     ("bad_g007_cache.json", "RNB-G007"),
     ("bad_g008_dtype.json", "RNB-G008"),
+    ("bad_g009_ragged.json", "RNB-G009"),
 ]
 
 
@@ -53,6 +54,66 @@ def test_good_autotune_fixture_is_clean():
     # in-warmed-set bucket restriction passes RNB-G006
     from rnb_tpu.analysis.graph import check_config
     assert check_config(_fixture("good_autotune.json")) == []
+
+
+def test_good_ragged_fixture_is_clean():
+    # the root 'ragged' key is consumed (no RNB-G001/G005), a matching
+    # pool_rows passes RNB-G009, and an autotune.buckets restriction
+    # naming counts the bucketed rule never warms (4, 10) passes
+    # RNB-G006 — legal only under ragged, where the candidate set is
+    # continuous up to the pool capacity
+    from rnb_tpu.analysis.graph import check_config
+    findings = check_config(_fixture("good_ragged.json"))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_ragged_pool_mismatch_across_stages_triggers_g006():
+    # omitted ragged.pool_rows: each stage resolves its OWN declared
+    # max, so a loader pool (15) feeding a bigger runner pool (30)
+    # would be a mid-run recompile — the edge check must treat the
+    # ragged consumer's warmed set as exactly its pool, not its
+    # counterfactual row_buckets
+    import json
+    import os as _os
+    import tempfile
+    from rnb_tpu.analysis.graph import check_config
+    raw = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "ragged": {"enabled": True},
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DFusingLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "fuse": 6},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [0], "in_queue": 0}],
+             "max_rows": 30, "row_buckets": [15, 30]}],
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _os.path.join(tmp, "pool_mismatch.json")
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        findings = check_config(path)
+    assert {f.rule for f in findings} == {"RNB-G006"}, \
+        [f.render() for f in findings]
+
+
+def test_ragged_buckets_without_ragged_still_trigger_g006():
+    # the same out-of-warmed-set restriction WITHOUT the ragged key
+    # must keep firing — the relaxation is scoped to ragged configs
+    import json
+    from rnb_tpu.analysis.graph import check_config
+    with open(_fixture("good_ragged.json")) as f:
+        raw = json.load(f)
+    del raw["ragged"]
+    import tempfile, os as _os
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _os.path.join(tmp, "no_ragged.json")
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        findings = check_config(path)
+    assert {f.rule for f in findings} == {"RNB-G006"}, \
+        [f.render() for f in findings]
 
 
 @pytest.mark.parametrize("name,rule", GRAPH_CASES)
@@ -207,6 +268,10 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Autotune buckets: %s\\n" % b)\n'
                      'f.write("Trace: events=%d\\n" % t)\n'
                      'f.write("Phases: %s\\n" % p)\n'
+                     'f.write("Ragged: pool_rows=%d\\n" % r)\n'
+                     'f.write("Padding: pad_rows=%d\\n" % pd)\n'
+                     'f.write("Compiles: %s\\n" % c)\n'
+                     'f.write("Warmup: %s\\n" % w)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -240,7 +305,11 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'f.write("Autotune: decisions=%d immediate=%d held=%d '
         'emissions=%d deadline_us_min=%d deadline_us_max=%d '
         'deadline_us_sum=%d\\n" % w)\n'
-        'f.write("Trace: events=%d dropped=%d\\n" % v)\n')
+        'f.write("Trace: events=%d dropped=%d\\n" % v)\n'
+        'f.write("Ragged: pool_rows=%d emissions=%d rows=%d '
+        'pad_rows_eliminated=%d cache_hit_rows=%d\\n" % r)\n'
+        'f.write("Padding: pad_rows=%d total_rows=%d '
+        'pad_emissions=%d\\n" % p)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}
