@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace ragged
+.PHONY: lint test native stamps trace ragged multichip
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -34,6 +34,14 @@ trace:
 # pad_rows, and parse_utils --check green on both.
 ragged:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/ragged_demo.py
+
+# Replica scale-out A/B (README "Scale-out"): the two shipped
+# rnb-scaleout arms under one seeded saturating workload, asserting
+# >= 2.5x videos/s at 4 replicas, zero host-hop bytes on every
+# device-resident edge, and parse_utils --check green (including the
+# planner's predicted-vs-traced occupancy comparison).
+multichip:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/multichip_demo.py
 
 native:
 	$(MAKE) -C native

@@ -47,6 +47,17 @@ Rules
   anti-pattern PR 4 removed; decode into a ``rnb_tpu.staging``
   StagingPool slot instead, and baseline the copy fallback with its
   justification.
+* ``RNB-H008`` host-materialization-on-device-edge: a host
+  materialization call (``device_get``, ``np.asarray``/``np.array``,
+  ``.copy_to_host_async``, ``.tolist``) inside a device-resident
+  handoff path — a ``*Handoff*`` class method (or a module-level
+  function of a ``handoff*.py`` module) whose name does not mark it
+  as the host-mode path with a ``host`` component. The device-
+  resident edge contract (rnb_tpu.handoff) promises zero host-hop
+  bytes; a host bounce creeping into its take path would silently
+  void the contract while the ``Handoff:`` accounting kept claiming
+  d2d. Route the call through a ``*host*``-named method (the
+  explicit host-mode arm) or fix it.
 """
 
 from __future__ import annotations
@@ -372,6 +383,48 @@ def _lint_fault_determinism(rel: str, index: _ModuleIndex,
                     "stateless draws like faults._hash_draw)" % bad))
 
 
+#: host-materialization calls RNB-H008 rejects on device-resident
+#: handoff paths (attribute names; np-receiver checked for asarray/
+#: array)
+_H008_NP_CALLS = {"asarray", "array"}
+_H008_ATTR_CALLS = {"device_get", "copy_to_host_async", "tolist"}
+
+
+def _lint_handoff_device_paths(rel: str, index: _ModuleIndex,
+                               findings: List[Finding]) -> None:
+    """RNB-H008: no host materialization inside a device-resident
+    handoff path. Scope: methods of ``*Handoff*`` classes and
+    module-level functions of ``handoff*.py`` modules; a ``host``
+    component in the function name marks the designated host-mode
+    path and exempts it (that arm exists to bounce, measurably)."""
+    is_handoff_module = os.path.basename(rel).startswith("handoff")
+    for qual, node in index.functions.items():
+        cls, _, meth = qual.rpartition(".")
+        name = meth or qual
+        in_scope = "Handoff" in cls or (is_handoff_module and not cls)
+        if not in_scope or "host" in name.lower():
+            continue
+        for sub in _own_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            bad = None
+            if isinstance(f, ast.Attribute):
+                if f.attr in _H008_ATTR_CALLS:
+                    bad = ".%s()" % f.attr
+                elif f.attr in _H008_NP_CALLS \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in _NP_NAMES:
+                    bad = "np.%s()" % f.attr
+            if bad is not None:
+                findings.append(Finding(
+                    "RNB-H008", rel, sub.lineno, qual,
+                    "%s on a device-resident handoff path — the edge "
+                    "contract promises zero host-hop bytes; move the "
+                    "call into the '*host*'-named host-mode path or "
+                    "fix it" % bad))
+
+
 def _lint_shed_ordering(rel: str, index: _ModuleIndex,
                         findings: List[Finding]) -> None:
     for qual, node in index.functions.items():
@@ -427,6 +480,7 @@ def check_file(path: str, root: str = ".") -> List[Finding]:
 
     _lint_fault_determinism(rel, index, findings)
     _lint_shed_ordering(rel, index, findings)
+    _lint_handoff_device_paths(rel, index, findings)
     return findings
 
 

@@ -25,8 +25,9 @@ Rules
 * ``RNB-T005`` unparsed-meta-or-trailer: a registered meta-line prefix
   or trailer kind ``parse_utils`` never checks for.
 * ``RNB-T006`` result-field-drift: a ``key=value`` counter written to
-  the Faults:/Cache:/Staging:/Autotune:/Trace:/Ragged:/Padding:
-  log-meta lines with no matching ``BenchmarkResult`` field (or vice
+  the Faults:/Cache:/Staging:/Autotune:/Trace:/Ragged:/Handoff:/
+  Padding: log-meta lines with no matching ``BenchmarkResult`` field
+  (or vice
   versa for those counter families; dict-valued fields — bucket
   counts, per-edge overflows, compile signatures, warmup seconds —
   ride their own JSON meta lines and are exempt).
@@ -235,6 +236,7 @@ COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Autotune:": "autotune_",
                          "Trace:": "trace_",
                          "Ragged:": "ragged_",
+                         "Handoff:": "handoff_",
                          "Padding:": ""}
 
 #: verbatim-named counter fields (prefix "") the reverse RNB-T006
@@ -453,7 +455,8 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
                 or field.startswith("staging_") \
                 or field.startswith("autotune_") \
                 or field.startswith("trace_") \
-                or field.startswith("ragged_"):
+                or field.startswith("ragged_") \
+                or field.startswith("handoff_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,

@@ -330,10 +330,20 @@ class Batcher(StageModel):
         """
         jax, jnp = _jax_numpy()
 
-        same_device = (
-            all(isinstance(pb.data, jax.Array) for pb in parts)
-            and len({d for pb in parts for d in pb.data.devices()}) == 1)
-        if same_device:
+        # "fusable on device" = identical placement: the seed rule
+        # (every part on the SAME single device) OR — under the
+        # device-resident edge contract (rnb_tpu.handoff), where
+        # payloads may arrive mesh-sharded — equal shardings. Both
+        # alternatives are needed: a NamedSharding over a 1-device
+        # mesh and a SingleDeviceSharding on that device compare
+        # unequal as objects yet fuse on device identically, and
+        # falling to the host-numpy path for them would be the host
+        # bounce the handoff exists to delete.
+        all_jax = all(isinstance(pb.data, jax.Array) for pb in parts)
+        same_placement = all_jax and (
+            len({d for pb in parts for d in pb.data.devices()}) == 1
+            or len({pb.data.sharding for pb in parts}) == 1)
+        if same_placement:
             segments = [pb.data[: pb.valid] for pb in parts]
             pad = bucket - valid
             if pad > 0:

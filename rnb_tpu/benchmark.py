@@ -174,6 +174,28 @@ class BenchmarkResult:
     #: per-step stage-construction wall seconds (weights + warmup
     #: compiles), summed over the step's instances
     warmup_s: Dict[str, float] = field(default_factory=dict)
+    #: device-resident handoff accounting (rnb_tpu.handoff), summed
+    #: over every consumer executor; all zero without the root
+    #: `handoff` config key. Every ring-payload take is one edge
+    #: event, classified d2d (adopted / resharded on-device) or host
+    #: (the explicit host round trip), with the bytes each class
+    #: moved — d2d_edges + host_edges == edges always, and a
+    #: device-resident config must show host_bytes == 0.
+    handoff_edges: int = 0
+    handoff_d2d_edges: int = 0
+    handoff_host_edges: int = 0
+    handoff_d2d_bytes: int = 0
+    handoff_host_bytes: int = 0
+    #: per-edge-label handoff counters (the `Handoff edges:` JSON
+    #: meta line)
+    handoff_edge_detail: Dict[str, Dict[str, int]] = \
+        field(default_factory=dict)
+    #: measured-cost placement report (rnb_tpu.placement): per-step
+    #: measured dispatch costs, the executed plan's predicted
+    #: occupancy, and the recommendation over the device budget —
+    #: the `Placement:` JSON meta line verbatim. Empty without the
+    #: root `placement` config key.
+    placement: Dict[str, Any] = field(default_factory=dict)
 
 
 def run_benchmark(config_path: str,
@@ -290,6 +312,28 @@ def run_benchmark(config_path: str,
                   "pipeline stage supports it — every emission stays "
                   "bucketed and no Ragged: telemetry will be emitted",
                   file=sys.stderr)
+
+    # device-resident handoff (root 'handoff' key, rnb_tpu.handoff):
+    # consumer executors apply the edge contract to every ring payload
+    # take and account d2d vs host-hop moves; absent => the stage
+    # models' own re-homing, no accounting, byte-stable logs
+    from rnb_tpu.handoff import HandoffSettings, InflightDepths
+    handoff_settings = HandoffSettings.from_config(config.handoff)
+    handoff_sink: list = []
+    # measured-cost placement (root 'placement' key,
+    # rnb_tpu.placement): every executor measures its dispatch busy
+    # spans; the launcher turns them into the Placement: plan line.
+    # (Apply-mode replica counts were already expanded at parse time.)
+    from rnb_tpu.placement import PlacementSettings
+    placement_settings = PlacementSettings.from_config(config.placement)
+    placement_sink = [] if placement_settings is not None else None
+    # replica-lane depth counters: one shared InflightDepths per
+    # replica-expanded step, feeding the upstream ReplicaSelector's
+    # least-loaded routing and settled by the replica executors
+    depths_by_step = {
+        step_idx: InflightDepths(step.replica_queues)
+        for step_idx, step in enumerate(config.steps)
+        if step.replica_queues}
 
     fault_plan = FaultPlan.resolve(config.fault_plan)
     if fault_plan is not None:
@@ -415,6 +459,20 @@ def run_benchmark(config_path: str,
                     pad_sink=pad_sink,
                     ragged_sink=ragged_sink,
                     tracer=tracer,
+                    handoff_settings=handoff_settings,
+                    handoff_edge=("step%d->step%d"
+                                  % (step_idx - 1, step_idx)
+                                  if step_idx > 0 else ""),
+                    handoff_sink=handoff_sink,
+                    placement_sink=placement_sink,
+                    out_depths=depths_by_step.get(step_idx + 1),
+                    out_queue_indices=(list(group.out_queues)
+                                       if group.out_queues else None),
+                    in_depths=(depths_by_step.get(step_idx)
+                               if step.replica_queues
+                               and group.in_queue
+                               in step.replica_queues else None),
+                    in_queue_idx=group.in_queue,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -599,6 +657,19 @@ def run_benchmark(config_path: str,
                         "cache_hit_rows"):
                 ragged_stats[key] += int(snap.get(key, 0))
 
+    handoff_stats = None
+    if handoff_sink:
+        from rnb_tpu.handoff import aggregate_snapshots as \
+            aggregate_handoff
+        handoff_stats = aggregate_handoff(handoff_sink)
+    placement_report = None
+    if placement_sink is not None:
+        import jax
+        from rnb_tpu.placement import build_report
+        placement_report = build_report(placement_sink, total_time,
+                                        len(jax.devices()),
+                                        placement_settings.mode)
+
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
     num_shed = faults["num_shed"]
@@ -686,6 +757,29 @@ def run_benchmark(config_path: str,
                        ragged_stats["emissions"], ragged_stats["rows"],
                        ragged_stats["pad_rows_eliminated"],
                        ragged_stats["cache_hit_rows"]))
+        if handoff_stats is not None:
+            # only handoff-enabled runs carry the lines, keeping
+            # pre-handoff logs byte-stable with the earlier schema;
+            # d2d_edges + host_edges == edges and host_bytes == 0 on
+            # device-resident edges are --check invariants
+            f.write("Handoff: edges=%d d2d_edges=%d host_edges=%d "
+                    "d2d_bytes=%d host_bytes=%d\n"
+                    % (handoff_stats["edges"],
+                       handoff_stats["d2d_edges"],
+                       handoff_stats["host_edges"],
+                       handoff_stats["d2d_bytes"],
+                       handoff_stats["host_bytes"]))
+            if handoff_stats["edge_detail"]:
+                f.write("Handoff edges: %s\n"
+                        % json.dumps(handoff_stats["edge_detail"],
+                                     sort_keys=True))
+        if placement_report is not None:
+            # the measured-cost plan: per-step dispatch costs, the
+            # executed plan's predicted occupancy (parse_utils --check
+            # holds it to the traced busy fraction), and the
+            # recommendation over the device budget
+            f.write("Placement: %s\n"
+                    % json.dumps(placement_report, sort_keys=True))
         if compile_stats:
             # per-step jit-entry signatures: warmup vocabulary size +
             # signatures first seen inside the measured window
@@ -764,6 +858,17 @@ def run_benchmark(config_path: str,
                  autotune_stats["emissions"],
                  json.dumps(autotune_stats["bucket_counts"],
                             sort_keys=True)))
+    if handoff_stats is not None and print_progress:
+        print("Handoff: %d edge take(s) — %d d2d (%.1f MiB on-device) "
+              "/ %d host (%.1f MiB through host memory)"
+              % (handoff_stats["edges"], handoff_stats["d2d_edges"],
+                 handoff_stats["d2d_bytes"] / (1 << 20),
+                 handoff_stats["host_edges"],
+                 handoff_stats["host_bytes"] / (1 << 20)))
+    if placement_report is not None and print_progress:
+        print("Placement plan (predicted occupancy over %d devices): %s"
+              % (placement_report["device_budget"],
+                 json.dumps(placement_report["plan"], sort_keys=True)))
     if ragged_stats is not None and print_progress:
         print("Ragged: %d emission(s), %d valid row(s) at pool_rows=%d"
               ", %d pad row(s) eliminated vs the bucketed rule, "
@@ -874,6 +979,18 @@ def run_benchmark(config_path: str,
                                if ragged_stats else 0),
         compile_signatures=compile_stats,
         warmup_s=warmup_stats,
+        handoff_edges=handoff_stats["edges"] if handoff_stats else 0,
+        handoff_d2d_edges=(handoff_stats["d2d_edges"]
+                           if handoff_stats else 0),
+        handoff_host_edges=(handoff_stats["host_edges"]
+                            if handoff_stats else 0),
+        handoff_d2d_bytes=(handoff_stats["d2d_bytes"]
+                           if handoff_stats else 0),
+        handoff_host_bytes=(handoff_stats["host_bytes"]
+                            if handoff_stats else 0),
+        handoff_edge_detail=(dict(handoff_stats["edge_detail"])
+                             if handoff_stats else {}),
+        placement=placement_report or {},
     )
 
 
@@ -952,6 +1069,18 @@ def main(argv=None) -> int:
         print("ragged: %s"
               % (json.dumps(cfg.ragged, sort_keys=True)
                  if cfg.ragged else "none"))
+        print("handoff: %s"
+              % (json.dumps(cfg.handoff, sort_keys=True)
+                 if cfg.handoff else "none"))
+        replicated = {"step%d" % i: len(s.replica_queues)
+                      for i, s in enumerate(cfg.steps)
+                      if s.replica_queues}
+        print("placement: %s%s"
+              % (json.dumps(cfg.placement, sort_keys=True)
+                 if cfg.placement else "none",
+                 "; replica lanes: %s" % json.dumps(replicated,
+                                                    sort_keys=True)
+                 if replicated else ""))
         print("trace: %s"
               % (json.dumps(cfg.trace, sort_keys=True)
                  if cfg.trace else "none"))

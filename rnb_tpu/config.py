@@ -29,6 +29,7 @@ RESERVED_KEYWORDS = [
     "model", "queue_groups", "num_shared_tensors", "num_segments",
     "in_queue", "out_queues", "devices", "gpus", "queue_selector",
     "async_dispatch", "max_retries", "retry_backoff_ms", "autotune",
+    "replicas",
 ]
 
 #: root-level keys with meaning to the runtime (everything else at the
@@ -36,7 +37,7 @@ RESERVED_KEYWORDS = [
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
-    "trace", "ragged", "_comment",
+    "trace", "ragged", "handoff", "placement", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -52,6 +53,12 @@ TRACE_KEYWORDS = ["enabled", "sample_hz", "max_events"]
 #: keys a root 'ragged' object may carry (rnb_tpu.ops.ragged)
 RAGGED_KEYWORDS = ["enabled", "pool_rows"]
 
+#: keys a root 'handoff' object may carry (rnb_tpu.handoff)
+HANDOFF_KEYWORDS = ["enabled", "mode"]
+
+#: keys a root 'placement' object may carry (rnb_tpu.placement)
+PLACEMENT_KEYWORDS = ["enabled", "mode", "plan"]
+
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
 #: can check the effective slot count at parse time.
@@ -66,6 +73,10 @@ def _effective_shared_tensors(num_shared_tensors: Optional[int]) -> int:
             else DEFAULT_NUM_SHARED_TENSORS)
 
 DEFAULT_QUEUE_SELECTOR = "rnb_tpu.selector.RoundRobinSelector"
+
+#: the selector replica expansion swaps in for the default on the
+#: producer side of a replica-expanded edge (least-loaded routing)
+REPLICA_QUEUE_SELECTOR = "rnb_tpu.selector.ReplicaSelector"
 
 
 class ConfigError(ValueError):
@@ -116,6 +127,12 @@ class StepConfig:
     #: controller (root 'autotune' key, rnb_tpu.autotune); the step
     #: then keeps its static batching knobs exactly as configured
     autotune: bool = True
+    #: set on replica-expanded steps (step key ``replicas: N`` or a
+    #: placement-apply plan): the per-replica lane queue indices, in
+    #: replica order. The launcher builds the shared
+    #: rnb_tpu.handoff.InflightDepths over these so the upstream
+    #: ReplicaSelector routes least-loaded (rnb_tpu.selector).
+    replica_queues: Optional[tuple] = None
 
     @property
     def effective_shared_tensors(self) -> int:
@@ -163,6 +180,18 @@ class PipelineConfig:
     #: a flat row pool at ONE compiled shape with a rows_valid scalar
     #: and per-request segment offsets instead of padding to buckets
     ragged: Optional[Dict[str, Any]] = None
+    #: validated device-resident handoff spec ({"enabled": ..,
+    #: "mode": "device"|"host"}), or None for the pre-handoff edge
+    #: semantics (stage models re-home their own inputs, no
+    #: accounting, byte-stable logs) — rnb_tpu.handoff
+    handoff: Optional[Dict[str, Any]] = None
+    #: validated placement-planner spec ({"enabled": .., "mode":
+    #: "plan"|"apply", "plan": {"step<i>": replicas}}), or None; when
+    #: set the launcher measures per-stage dispatch costs and writes
+    #: the Placement: log-meta plan line (rnb_tpu.placement); "apply"
+    #: additionally expands the named steps' replica counts at parse
+    #: time exactly like a hand-written ``replicas`` key
+    placement: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -187,6 +216,142 @@ class PipelineConfig:
         """Resolve every placement against the visible JAX devices."""
         from rnb_tpu.devices import check_devices
         check_devices(self.all_devices())
+
+
+def _expand_replicas(pipeline: list, placement: Optional[Dict[str, Any]]
+                     ) -> tuple:
+    """Replica-sharded serving (PR 9): expand every step declaring
+    ``replicas: N`` (or named by an apply-mode placement plan) into N
+    queue groups — one per replica, each with its own fresh lane queue
+    and an equal slice of the step's device list (the per-replica
+    sub-mesh) — and rewire the upstream producers onto the lanes with
+    the least-loaded ReplicaSelector swapped in for the default.
+
+    Returns ``(expanded_pipeline, {step_idx: (lane queue indices)})``;
+    the input list is never mutated (``config.raw`` keeps the
+    as-written form). Expansion happens at parse time so everything
+    downstream — fabric wiring, the static graph checker, the job-dir
+    config copy — sees one canonical multi-group form.
+    """
+    import copy
+
+    plan: Dict[int, int] = {}
+    if placement is not None and placement.get("enabled", True) \
+            and placement.get("mode", "plan") == "apply":
+        for key, val in (placement.get("plan") or {}).items():
+            plan[int(key[4:])] = int(val)
+
+    wants: Dict[int, Any] = {}
+    for step_idx, step in enumerate(pipeline):
+        if not isinstance(step, dict):
+            continue
+        n = step.get("replicas")
+        if n is None:
+            # an explicit per-step ``replicas`` wins over the plan —
+            # the plan is advice, the step key is the operator's word
+            n = plan.get(step_idx)
+        if n is not None:
+            wants[step_idx] = n
+
+    for step_idx, n in wants.items():
+        _expect(isinstance(n, int) and not isinstance(n, bool)
+                and n >= 1,
+                "pipeline step %d: 'replicas' must be a positive "
+                "integer, got %r" % (step_idx, n))
+    if not wants:
+        return pipeline, {}
+
+    pipeline = copy.deepcopy(pipeline)
+    used = set()
+    for step in pipeline:
+        if not isinstance(step, dict):
+            continue
+        for g in step.get("queue_groups") or []:
+            if not isinstance(g, dict):
+                continue
+            if isinstance(g.get("in_queue"), int):
+                used.add(g["in_queue"])
+            for q in g.get("out_queues") or []:
+                if isinstance(q, int):
+                    used.add(q)
+    next_q = max(used) + 1 if used else 0
+
+    replica_queues: Dict[int, tuple] = {}
+    for step_idx in sorted(wants):
+        n = wants[step_idx]
+        step = pipeline[step_idx]
+        step.pop("replicas", None)
+        # the structural constraints hold for EVERY declared replicas
+        # key, n == 1 included — otherwise an operator iterating
+        # replica counts would hit a "regression" at n=2 for a
+        # topology that was invalid (but silently accepted) at n=1
+        where = "pipeline step %d" % step_idx
+        _expect(step_idx > 0,
+                "%s: 'replicas' needs a routable in_queue; the first "
+                "step reads the shared filename queue — replicate it "
+                "by listing more devices instead" % where)
+        _expect(step.get("num_segments", 1) == 1,
+                "%s: 'replicas' cannot be combined with "
+                "'num_segments' > 1 (segment siblings must reach one "
+                "aggregator, which per-replica lanes cannot "
+                "guarantee)" % where)
+        groups = step.get("queue_groups")
+        _expect(isinstance(groups, list) and len(groups) == 1
+                and isinstance(groups[0], dict),
+                "%s: 'replicas' requires exactly one queue group to "
+                "expand" % where)
+        g = groups[0]
+        dev_key = ("devices" if "devices" in g
+                   else "gpus" if "gpus" in g else None)
+        _expect(dev_key is not None,
+                "%s, queue group 0 needs a 'devices' list" % where)
+        devices = g[dev_key]
+        _expect(isinstance(devices, list) and devices
+                and len(devices) % n == 0,
+                "%s: 'replicas'=%d must evenly divide the %d-entry "
+                "device list — each replica owns an equal sub-mesh"
+                % (where, n, len(devices) if isinstance(devices, list)
+                   else 0))
+        orig_in = g.get("in_queue")
+        _expect(isinstance(orig_in, int),
+                "%s, queue group 0 needs an integer 'in_queue'" % where)
+        if n == 1:
+            # validated but structurally a no-op: the existing queue
+            # IS the single lane, so no rewiring (and no selector
+            # swap) happens
+            continue
+
+        lanes = list(range(next_q, next_q + n))
+        next_q += n
+        # the per-replica sub-mesh rule lives with the mesh factoring
+        # (rnb_tpu.parallel.mesh): contiguous equal device slices
+        from rnb_tpu.parallel.mesh import carve_replicas
+        new_groups = []
+        for lane, sub_mesh in zip(lanes, carve_replicas(devices, n)):
+            ng = copy.deepcopy(g)
+            ng[dev_key] = sub_mesh
+            ng["in_queue"] = lane
+            new_groups.append(ng)
+        step["queue_groups"] = new_groups
+
+        rewired = False
+        for ug in pipeline[step_idx - 1].get("queue_groups") or []:
+            if not isinstance(ug, dict):
+                continue
+            outs = list(ug.get("out_queues") or [])
+            if orig_in not in outs:
+                continue
+            pos = outs.index(orig_in)
+            ug["out_queues"] = outs[:pos] + lanes + outs[pos + 1:]
+            if ug.get("queue_selector",
+                      DEFAULT_QUEUE_SELECTOR) == DEFAULT_QUEUE_SELECTOR:
+                ug["queue_selector"] = REPLICA_QUEUE_SELECTOR
+            rewired = True
+        _expect(rewired,
+                "%s: no upstream queue group names out-queue %d, so "
+                "the replica lanes cannot be wired" % (where, orig_in))
+        replica_queues[step_idx] = tuple(lanes)
+    return pipeline, replica_queues
 
 
 def load_config(path: str) -> PipelineConfig:
@@ -330,6 +495,57 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     "'ragged' cannot be combined with 'num_segments' "
                     "> 1: the pool is one fixed dispatch shape")
 
+    handoff = raw.get("handoff")
+    if handoff is not None:
+        _expect(isinstance(handoff, dict), "'handoff' must be an object")
+        unknown_ho = sorted(set(handoff) - set(HANDOFF_KEYWORDS))
+        _expect(not unknown_ho,
+                "'handoff' has unknown key(s) %s — keys are %s"
+                % (unknown_ho, HANDOFF_KEYWORDS))
+        _expect(isinstance(handoff.get("enabled", True), bool),
+                "'handoff.enabled' must be a boolean")
+        mode = handoff.get("mode", "device")
+        _expect(mode in ("device", "host"),
+                "'handoff.mode' must be \"device\" (device-resident "
+                "edges) or \"host\" (the explicit host round-trip "
+                "baseline arm), got %r" % (mode,))
+
+    placement = raw.get("placement")
+    if placement is not None:
+        _expect(isinstance(placement, dict),
+                "'placement' must be an object")
+        unknown_pl = sorted(set(placement) - set(PLACEMENT_KEYWORDS))
+        _expect(not unknown_pl,
+                "'placement' has unknown key(s) %s — keys are %s"
+                % (unknown_pl, PLACEMENT_KEYWORDS))
+        _expect(isinstance(placement.get("enabled", True), bool),
+                "'placement.enabled' must be a boolean")
+        pl_mode = placement.get("mode", "plan")
+        _expect(pl_mode in ("plan", "apply"),
+                "'placement.mode' must be \"plan\" (report the "
+                "measured-cost plan) or \"apply\" (apply 'plan' replica "
+                "counts at launch), got %r" % (pl_mode,))
+        plan = placement.get("plan")
+        if pl_mode == "apply":
+            _expect(isinstance(plan, dict) and plan,
+                    "'placement.mode' \"apply\" needs a non-empty "
+                    "'plan' object ({\"step<i>\": replicas})")
+        if plan is not None:
+            _expect(isinstance(plan, dict), "'placement.plan' must be "
+                    "an object")
+            for key, val in plan.items():
+                ok_key = (isinstance(key, str) and key.startswith("step")
+                          and key[4:].isdigit()
+                          and int(key[4:]) < len(pipeline))
+                _expect(ok_key,
+                        "'placement.plan' keys must be \"step<i>\" with "
+                        "i inside the pipeline (0..%d), got %r"
+                        % (len(pipeline) - 1, key))
+                _expect(isinstance(val, int)
+                        and not isinstance(val, bool) and val >= 1,
+                        "'placement.plan.%s' must be a positive integer "
+                        "replica count, got %r" % (key, val))
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -339,6 +555,12 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
             FaultPlan(fault_plan).check_steps(len(pipeline))
         except ValueError as e:
             raise ConfigError("invalid 'fault_plan': %s" % e) from e
+
+    # replica-sharded serving: expand `replicas` steps (and an
+    # apply-mode placement plan) into per-replica lane groups BEFORE
+    # any wiring validation, so the expanded form is the one canonical
+    # topology everything checks and builds
+    pipeline, replica_queues = _expand_replicas(pipeline, placement)
 
     steps: List[StepConfig] = []
     prev_out_queues: Optional[set] = None
@@ -503,7 +725,9 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                                 async_dispatch=async_dispatch,
                                 max_retries=max_retries,
                                 retry_backoff_ms=float(retry_backoff_ms),
-                                autotune=step_autotune))
+                                autotune=step_autotune,
+                                replica_queues=replica_queues.get(
+                                    step_idx)))
 
     return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
                           steps=steps, raw=raw,
@@ -513,4 +737,6 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           popularity=popularity,
                           autotune=autotune,
                           ragged=ragged,
+                          handoff=handoff,
+                          placement=placement,
                           trace=trace)

@@ -2326,6 +2326,18 @@ class R2P1DMeshRunner(StageModel):
         # path: the sharded step's own batch geometry
         return (self._si.batch_shape(1)[1:],)
 
+    def input_sharding(self):
+        """Edge-contract target (rnb_tpu.handoff, root ``handoff``
+        key): per-item payloads land mesh-replicated, so the
+        ``dp``-stacked dispatch reshards purely on-device — the
+        sharded program's clip padding happens inside the jit, so the
+        raw per-video clip axis cannot be pre-split over ``sp``
+        (max_clips need not divide), but a replicated placement
+        already puts the bytes on every core the shard_map will
+        read from."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self._si.mesh, PartitionSpec())
+
     @classmethod
     def input_shape_for(cls, max_clips: int = MAX_CLIPS,
                         consecutive_frames: int = CONSECUTIVE_FRAMES,

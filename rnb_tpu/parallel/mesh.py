@@ -121,6 +121,27 @@ def build_mesh(devices: Optional[Sequence] = None,
     return Mesh(grid, names)
 
 
+def carve_replicas(devices: Sequence, replicas: int) -> List[list]:
+    """Carve a step's device list into ``replicas`` disjoint equal
+    sub-meshes, in order — the replica expansion's placement rule
+    (rnb_tpu.config ``replicas: N`` / placement apply): replica i owns
+    ``devices[i*k:(i+1)*k]`` with ``k = len(devices)//replicas``, so
+    contiguous device ranges (adjacent cores on real topologies) stay
+    together inside one replica. Works on raw config indices or
+    resolved devices alike."""
+    devices = list(devices)
+    replicas = int(replicas)
+    if replicas < 1:
+        raise ValueError("need at least one replica, got %d" % replicas)
+    if not devices or len(devices) % replicas:
+        raise ValueError(
+            "%d device(s) cannot split into %d equal replica "
+            "sub-meshes" % (len(devices), replicas))
+    chunk = len(devices) // replicas
+    return [devices[i * chunk:(i + 1) * chunk]
+            for i in range(replicas)]
+
+
 def submeshes(devices: Sequence, stage_sizes: Sequence[int],
               axes_per_stage: Sequence[Optional[Dict[str, int]]] = None):
     """Carve ``devices`` into disjoint consecutive sub-meshes.

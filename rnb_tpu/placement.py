@@ -1,0 +1,163 @@
+"""Measured-cost placement planner: replication plans from live costs.
+
+The pipeline's replica counts used to be whatever the config author
+guessed. Following AoiZora (PAPERS.md: choose the replication /
+partition plan from topology plus *measured* per-stage costs), this
+module closes the loop: the executors measure every stage's dispatch
+cost over the run's wall window, the planner turns those costs into a
+replication plan over the visible device budget, and ``parse_utils
+--check`` holds the plan's occupancy *prediction* to the occupancy the
+trace timeline actually recorded — a plan whose model drifts from
+reality fails the check instead of silently misplacing the next run.
+
+Cost model (deliberately the queueing-free first-order one — the
+per-stage numbers it needs are exactly what the runtime already
+measures):
+
+* per-dispatch service ``c_i`` = measured busy seconds / dispatches —
+  *busy* is the executor's dispatch span (injected fault-plan latency
+  + model call + device sync), the same spans the trace timeline
+  records as ``exec{i}.model_call``/``exec{i}.device_sync``, so the
+  offline check compares like with like;
+* offered load ``L_i = rate_i * c_i`` device-seconds per second, with
+  ``rate_i`` = dispatches / wall;
+* predicted occupancy at ``n`` replicas: ``L_i / n`` — for the
+  *executed* plan (``n`` = configured instances) this must land within
+  tolerance of the traced busy fraction (the model-consistency check);
+  for the *recommendation* the same per-dispatch costs extrapolate.
+
+Recommendation: allocate the device budget greedily — every step gets
+one device, then each remaining device goes to the step with the
+highest predicted occupancy (ties: lowest step index) — minimizing the
+predicted bottleneck occupancy. First-order by design: it ignores
+queueing variance and host-side coupling, which is why the prediction
+is *checked*, not trusted.
+
+Config (root key, validated in rnb_tpu.config)::
+
+    "placement": {"mode": "plan"}                         // report only
+    "placement": {"mode": "apply", "plan": {"step1": 4}}  // auto-apply
+
+``mode: "plan"`` emits the measured costs + recommendation as the
+``Placement:`` log-meta JSON line. ``mode: "apply"`` additionally
+applies the named replica counts at parse time — each ``step<i>``
+entry becomes that step's ``replicas`` (unless the step already
+declares one), going through the same replica expansion a hand-written
+``replicas`` key does — and still emits the line, so an applied plan's
+prediction is verified like any other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: modes the root ``placement`` config key accepts
+PLACEMENT_MODES = ("plan", "apply")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementSettings:
+    """Validated view of the ``placement`` root config key."""
+
+    mode: str
+    #: step index -> replica count to apply (apply mode only)
+    plan: Tuple[Tuple[int, int], ...] = ()
+
+    @staticmethod
+    def from_config(raw: Optional[dict]) -> Optional["PlacementSettings"]:
+        """Settings from the (schema-validated) config dict, or None
+        when the key is absent or ``enabled`` is false."""
+        if not raw or not raw.get("enabled", True):
+            return None
+        mode = raw.get("mode", "plan")
+        plan = tuple(sorted(
+            (int(key[4:]), int(val))
+            for key, val in dict(raw.get("plan") or {}).items()))
+        return PlacementSettings(mode=mode, plan=plan)
+
+
+@dataclasses.dataclass(frozen=True)
+class CostRecord:
+    """One executor instance's measured dispatch cost, appended by the
+    runner's teardown into the launcher's placement sink."""
+
+    step_idx: int
+    busy_s: float
+    dispatches: int
+
+
+def aggregate_costs(records: Sequence) -> Dict[int, Dict[str, float]]:
+    """Per-step sums over the executors' cost records:
+    {step_idx: {instances, busy_s, dispatches}}."""
+    out: Dict[int, Dict[str, float]] = {}
+    for rec in records:
+        step = out.setdefault(int(rec.step_idx),
+                              {"instances": 0, "busy_s": 0.0,
+                               "dispatches": 0})
+        step["instances"] += 1
+        step["busy_s"] += float(rec.busy_s)
+        step["dispatches"] += int(rec.dispatches)
+    return out
+
+
+def recommend(loads: Dict[int, float], device_budget: int
+              ) -> Dict[int, int]:
+    """Greedy replica allocation: minimize the predicted bottleneck
+    occupancy ``max_i loads[i] / n_i`` subject to ``sum n_i <=
+    device_budget`` and ``n_i >= 1``. Deterministic: ties go to the
+    lowest step index."""
+    steps = sorted(loads)
+    if not steps:
+        return {}
+    n = {s: 1 for s in steps}
+    spare = int(device_budget) - len(steps)
+    while spare > 0:
+        hottest = max(steps, key=lambda s: (loads[s] / n[s], -s))
+        if loads[hottest] <= 0.0:
+            break  # nothing left that predicts any occupancy
+        n[hottest] += 1
+        spare -= 1
+    return n
+
+
+def build_report(records: Sequence, wall_s: float, device_budget: int,
+                 mode: str) -> Optional[Dict[str, object]]:
+    """The ``Placement:`` log-meta payload for one finished run: the
+    per-step measured costs, the executed plan's predicted occupancy,
+    and the recommendation over the device budget. None when nothing
+    was measured (no dispatches or no wall window)."""
+    costs = aggregate_costs(records)
+    if not costs or wall_s <= 0.0:
+        return None
+    steps: Dict[str, Dict[str, object]] = {}
+    loads: Dict[int, float] = {}
+    for step_idx in sorted(costs):
+        c = costs[step_idx]
+        dispatches = int(c["dispatches"])
+        instances = int(c["instances"])
+        busy = float(c["busy_s"])
+        service_s = busy / dispatches if dispatches else 0.0
+        rate_hz = dispatches / wall_s
+        load = rate_hz * service_s
+        loads[step_idx] = load
+        steps["step%d" % step_idx] = {
+            "instances": instances,
+            "dispatches": dispatches,
+            "service_ms": round(service_s * 1000.0, 3),
+            "rate_hz": round(rate_hz, 4),
+            # the executed plan's prediction — what parse_utils
+            # --check holds to the traced busy fraction
+            "occupancy": round(load / instances if instances else 0.0,
+                               4),
+        }
+    plan = recommend(loads, device_budget)
+    return {
+        "mode": mode,
+        "device_budget": int(device_budget),
+        "steps": steps,
+        "plan": {"step%d" % s: {
+            "replicas": plan[s],
+            "occupancy": round(loads[s] / plan[s], 4)}
+            for s in sorted(plan)},
+    }

@@ -61,6 +61,7 @@ from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
 from rnb_tpu.devices import DeviceSpec
 from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
 from rnb_tpu.ops.ragged import check_segment_offsets
+from rnb_tpu.placement import CostRecord
 from rnb_tpu.stage import PaddedBatch, RaggedBatch
 from rnb_tpu.telemetry import TimeCardList, TimeCardSummary, logname
 from rnb_tpu.utils.class_utils import load_class
@@ -178,6 +179,36 @@ class RunnerContext:
     #: refine phase stamps / register occupancy sources, and opts the
     #: final-step summary into `# phases` trailers.
     tracer: Optional[Any] = None
+    #: device-resident handoff (root 'handoff' config key,
+    #: rnb_tpu.handoff): the job's HandoffSettings for consumer
+    #: stages (input_rings present), else None. The executor builds
+    #: one EdgeHandoff per instance and applies it to every ring
+    #: payload take; snapshots land in handoff_sink.
+    handoff_settings: Optional[Any] = None
+    #: edge label for this consumer's handoff accounting
+    #: ("step{i-1}->step{i}")
+    handoff_edge: str = ""
+    handoff_sink: Optional[List] = None
+    #: measured-cost placement (root 'placement' config key,
+    #: rnb_tpu.placement): when set, the executor accumulates its
+    #: dispatch busy seconds (fault-plan latency + model call +
+    #: device sync — the same work the trace timeline records) and
+    #: appends a CostRecord here at teardown
+    placement_sink: Optional[List] = None
+    #: replica-lane depth counters (rnb_tpu.handoff.InflightDepths)
+    #: when the NEXT step is replica-expanded: the producer increments
+    #: its chosen lane per successful enqueue and hands the counters
+    #: to its ReplicaSelector (least-loaded routing)
+    out_depths: Optional[Any] = None
+    #: config queue indices parallel to out_queues (lane addressing
+    #: for out_depths; None when out_depths is None)
+    out_queue_indices: Optional[List[int]] = None
+    #: this consumer's side of the replica-lane depth counters: the
+    #: executor decrements its lane once a popped item's processing
+    #: completed (loop-top settlement), closing the in-flight window
+    #: the producer's selector routes on
+    in_depths: Optional[Any] = None
+    in_queue_idx: Optional[int] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -348,7 +379,19 @@ def runner(ctx: RunnerContext) -> None:
     progress_bar = None
     declared_shapes = None
     controller = None
+    handoff = None
     warmup_s = 0.0
+    # measured-cost placement accounting (rnb_tpu.placement): busy =
+    # this executor's dispatch spans (fault-plan latency + model call
+    # + device sync), the same work the trace timeline records — the
+    # planner's occupancy prediction is checked against the traced
+    # busy fraction, so the two MUST measure the same thing
+    stage_busy_s = 0.0
+    stage_dispatches = 0
+    # replica-lane in-flight settlement: items popped whose depth
+    # decrement is owed at the next loop top (after their processing
+    # completed) — rnb_tpu.handoff.InflightDepths
+    depth_owed = 0
     try:
         model_class = load_class(ctx.model_class_path)
         # warmup wall time: weights + warmup compiles all happen in the
@@ -365,6 +408,21 @@ def runner(ctx: RunnerContext) -> None:
             selector_class = load_class(ctx.queue_selector_path)
             selector = selector_class(len(ctx.out_queues))
             selector.bind_stage(model)
+            if ctx.out_depths is not None \
+                    and hasattr(selector, "bind_depths"):
+                # replica-lane routing (rnb_tpu.selector
+                # .ReplicaSelector): share the downstream step's
+                # in-flight depth counters so routing is least-loaded
+                selector.bind_depths(ctx.out_depths,
+                                     ctx.out_queue_indices)
+        if ctx.handoff_settings is not None \
+                and ctx.input_rings is not None:
+            # device-resident handoff (rnb_tpu.handoff): this
+            # consumer's side of the edge contract, re-home target
+            # refined by the stage's input_sharding() when declared
+            from rnb_tpu.handoff import EdgeHandoff
+            handoff = EdgeHandoff(ctx.handoff_settings, ctx.device,
+                                  ctx.handoff_edge, model)
         if ctx.autotune is not None \
                 and getattr(model, "SUPPORTS_AUTOTUNE", False):
             # load-adaptive batching (rnb_tpu.autotune): the stage
@@ -429,6 +487,7 @@ def runner(ctx: RunnerContext) -> None:
     sec_ring_publish = "exec%d.ring_publish" % ctx.step_idx
     sec_bookkeeping = "exec%d.bookkeeping" % ctx.step_idx
     sec_enqueue = "exec%d.route+enqueue" % ctx.step_idx
+    sec_handoff = "exec%d.handoff" % ctx.step_idx
     # loop-invariant stamp keys the autotune service feed reads (these
     # are lookups of stamps the record() sites below write, not new
     # stamp sites)
@@ -443,6 +502,7 @@ def runner(ctx: RunnerContext) -> None:
     tr_model_call = trace.name("exec%d.model_call", ctx.step_idx)
     tr_device_sync = trace.name("exec%d.device_sync", ctx.step_idx)
     tr_publish = trace.name("exec%d.publish", ctx.step_idx)
+    tr_handoff = trace.name("exec%d.handoff", ctx.step_idx)
 
     # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
     # first stage exposing submit()/complete() gets its next requests'
@@ -458,6 +518,14 @@ def runner(ctx: RunnerContext) -> None:
     try:
         if model is not None:
             while not ctx.termination.terminated:
+                if depth_owed:
+                    # the previous iteration's popped item(s) have
+                    # fully processed: close their in-flight window so
+                    # the upstream ReplicaSelector stops counting them
+                    # against this lane
+                    if ctx.in_depths is not None:
+                        ctx.in_depths.dec(ctx.in_queue_idx, depth_owed)
+                    depth_owed = 0
                 # dead-letter requests the stage contained internally
                 # during the previous iteration (fused-batch members
                 # whose decode failed)
@@ -568,6 +636,12 @@ def runner(ctx: RunnerContext) -> None:
                             break  # end-of-stream marker
                     else:
                         signal, non_tensors, time_card = item
+                        if ctx.in_depths is not None:
+                            # settle at the NEXT loop top (processing
+                            # complete), not here — depth must cover
+                            # in-service time or the router's view
+                            # collapses to queue length
+                            depth_owed += 1
                         time_card.add_device(ctx.device.label)
                         time_card.record("runner%d_start" % ctx.step_idx)
                         if ctx.tracer is not None:
@@ -596,6 +670,15 @@ def runner(ctx: RunnerContext) -> None:
                                 # read — exit (reference runner.py:96-100)
                                 break
                             slot.release()
+                            if handoff is not None and tensors:
+                                # the edge contract (rnb_tpu.handoff):
+                                # adopt/reshard the committed payload
+                                # onto this consumer — and account the
+                                # move, so "zero host-hop bytes" is a
+                                # log fact, not a claim
+                                with hostprof.section(sec_handoff), \
+                                        trace.span(tr_handoff):
+                                    tensors = handoff.take(tensors)
                         else:
                             tensors = None
 
@@ -622,15 +705,24 @@ def runner(ctx: RunnerContext) -> None:
                     time_card.record("inference%d_start" % ctx.step_idx)
                     attempt = 0
                     failed_reason = None
+                    t_busy0 = (time.monotonic()
+                               if ctx.placement_sink is not None
+                               else None)
                     while True:
                         try:
-                            if ctx.fault_plan is not None:
-                                ctx.fault_plan.fire(ctx.step_idx, rids,
-                                                    attempt)
                             with hostprof.section(sec_model_call), \
                                     trace.span(tr_model_call,
                                                getattr(in_card, "id",
                                                        None)):
+                                if ctx.fault_plan is not None:
+                                    # inside the model_call span:
+                                    # injected 'latency' is emulated
+                                    # stage service, and the trace
+                                    # timeline / placement busy
+                                    # accounting must agree on what
+                                    # service means
+                                    ctx.fault_plan.fire(ctx.step_idx,
+                                                        rids, attempt)
                                 if handle is not None and attempt == 0:
                                     tensors_out, non_tensors_out, \
                                         time_card = model.complete(
@@ -678,14 +770,27 @@ def runner(ctx: RunnerContext) -> None:
                                 if summary is not None:
                                     summary.note_retries(1)
                                 if ctx.retry_backoff_ms > 0:
+                                    # backoff is idle wait, not
+                                    # service: pause the placement
+                                    # busy clock so the planner's
+                                    # busy window keeps matching the
+                                    # trace spans (which never see
+                                    # the sleep) under chaos runs
+                                    if t_busy0 is not None:
+                                        stage_busy_s += \
+                                            time.monotonic() - t_busy0
                                     time.sleep(
                                         ctx.retry_backoff_ms / 1000.0)
+                                    if t_busy0 is not None:
+                                        t_busy0 = time.monotonic()
                                 continue
                             failed_reason = fault_reason(exc)
                             if kind is TRANSIENT:
                                 failed_reason = ("retries-exhausted:"
                                                  + failed_reason)
                             break
+                    if t_busy0 is not None:
+                        stage_busy_s += time.monotonic() - t_busy0
                     if failed_reason is not None:
                         # permanent failure: dead-letter the request(s)
                         # and keep the stream flowing
@@ -700,10 +805,17 @@ def runner(ctx: RunnerContext) -> None:
                                  "step %d %s" % (ctx.step_idx,
                                                  ctx.model_class_path))
                 if ctx.sync_outputs and tensors_out:
+                    t_sync0 = (time.monotonic()
+                               if ctx.placement_sink is not None
+                               else None)
                     with hostprof.section(sec_device_sync), \
                             trace.span(tr_device_sync):
                         _block_on(tensors_out)
+                    if t_sync0 is not None:
+                        stage_busy_s += time.monotonic() - t_sync0
                 time_card.record("inference%d_finish" % ctx.step_idx)
+                if ctx.placement_sink is not None:
+                    stage_dispatches += 1
                 if controller is not None and tensors_out \
                         and flushed is None \
                         and not getattr(model, "AUTOTUNE_SELF_SERVICE",
@@ -823,6 +935,7 @@ def runner(ctx: RunnerContext) -> None:
                                 else:
                                     sig = None
                                 item = (sig, non_tensors_out, forked)
+                                enqueued = False
                                 if ctx.overload_policy == "shed":
                                     # capacity raced away since the
                                     # pre-check (competing producer):
@@ -834,11 +947,19 @@ def runner(ctx: RunnerContext) -> None:
                                             out_queue.put(
                                                 item,
                                                 timeout=QUEUE_POLL_S)
+                                            enqueued = True
                                             break
                                         except queue.Full:
                                             continue
                                 else:
                                     out_queue.put_nowait(item)
+                                    enqueued = True
+                                if enqueued \
+                                        and ctx.out_depths is not None:
+                                    # open the item's in-flight window
+                                    # on its chosen replica lane
+                                    ctx.out_depths.inc(
+                                        ctx.out_queue_indices[out_idx])
                     except queue.Full:
                         # counted telemetry, not a stray stdout line:
                         # the per-edge overflow count lands in
@@ -957,6 +1078,28 @@ def runner(ctx: RunnerContext) -> None:
                 and getattr(model, "ragged_stats", None) is not None):
             try:
                 ctx.ragged_sink.append(dict(model.ragged_stats))
+            except Exception:
+                traceback.print_exc()
+        # replica-lane settlement for an item still in service when
+        # the loop exited (abort / target-reached break)
+        if depth_owed and ctx.in_depths is not None:
+            ctx.in_depths.dec(ctx.in_queue_idx, depth_owed)
+            depth_owed = 0
+        # device-resident handoff accounting (rnb_tpu.handoff): the
+        # stage is drained, counters are stable
+        if ctx.handoff_sink is not None and handoff is not None:
+            try:
+                ctx.handoff_sink.append(handoff.snapshot())
+            except Exception:
+                traceback.print_exc()
+        # measured dispatch costs for the placement planner
+        # (rnb_tpu.placement) — every executor reports, planner-on runs
+        # only (the sink gates it)
+        if ctx.placement_sink is not None and model is not None:
+            try:
+                ctx.placement_sink.append(
+                    CostRecord(ctx.step_idx, stage_busy_s,
+                               stage_dispatches))
             except Exception:
                 traceback.print_exc()
         try:

@@ -40,3 +40,54 @@ class RoundRobinSelector(QueueSelector):
         choice = self._next
         self._next = (self._next + 1) % self.num_queues
         return choice
+
+
+class ReplicaSelector(QueueSelector):
+    """Least-loaded routing over replica lanes (PR 9 scale-out).
+
+    A step declaring ``replicas: N`` expands into N queue groups, each
+    with its own lane queue; the upstream producers' selector becomes
+    this one (rnb_tpu.config swaps it in for the default). Routing is
+    by **per-replica in-flight depth** — items enqueued minus items
+    whose processing the replica finished, tracked by a shared
+    :class:`rnb_tpu.handoff.InflightDepths` the executor binds via
+    :meth:`bind_depths` — so a replica wedged on a slow batch stops
+    receiving work, which a bare queue-length poll would miss (the
+    popped-and-in-service item is invisible to ``qsize``).
+
+    Deterministic: the minimum-depth lane wins, ties break to the
+    lowest lane index — under a seeded workload the routing sequence
+    is a pure function of the depth sequence. Without bound depths
+    (hand-written configs naming this selector on a non-replica edge)
+    it degrades to round-robin.
+    """
+
+    def __init__(self, num_queues: int):
+        super().__init__(num_queues)
+        self._rr = 0
+        self._depths = None          # rnb_tpu.handoff.InflightDepths
+        self._queue_indices = None   # lane position -> queue index
+
+    def bind_depths(self, depths, queue_indices) -> None:
+        """Executor protocol (rnb_tpu.runner): share the replica
+        step's depth counters and this producer's out-queue index
+        list (lane position -> config queue index)."""
+        if len(queue_indices) != self.num_queues:
+            raise ValueError(
+                "ReplicaSelector routes over %d queue(s) but was bound "
+                "to %d queue indices" % (self.num_queues,
+                                         len(queue_indices)))
+        self._depths = depths
+        self._queue_indices = [int(q) for q in queue_indices]
+
+    def select(self, tensors, non_tensors, time_card) -> int:
+        if self._depths is None:
+            choice = self._rr
+            self._rr = (self._rr + 1) % self.num_queues
+            return choice
+        best, best_depth = 0, None
+        for pos, q_idx in enumerate(self._queue_indices):
+            depth = self._depths.depth(q_idx)
+            if best_depth is None or depth < best_depth:
+                best, best_depth = pos, depth
+        return best

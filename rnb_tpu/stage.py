@@ -245,6 +245,20 @@ class StageModel:
     def input_shape(self) -> Optional[Sequence]:
         return None
 
+    def input_sharding(self):
+        """The ``jax.sharding.Sharding`` this stage wants its input
+        payloads homed on, or None for the instance's home device.
+
+        Consulted by the device-resident edge contract
+        (rnb_tpu.handoff.EdgeHandoff) under the root ``handoff``
+        config key: a mesh-resident stage (R2P1DMeshRunner) declares
+        its mesh placement here so the inter-stage edge re-homes
+        payloads as ONE on-device resharding — ICI on real hardware,
+        with the remote-DMA fast path when the move matches the ring
+        pattern (rnb_tpu.ops.handoff_dma) — instead of the stage
+        re-placing them inside its dispatch path."""
+        return None
+
     @staticmethod
     def output_shape() -> Optional[Tuple[Tuple[int, ...], ...]]:
         return None

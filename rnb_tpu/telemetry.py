@@ -161,6 +161,19 @@ META_LINE_REGISTRY = (
     StampSpec("Warmup:", "rnb_tpu/benchmark.py",
               "JSON per-step stage-construction wall seconds "
               "(weights + warmup compiles)"),
+    StampSpec("Handoff:", "rnb_tpu/benchmark.py",
+              "device-resident handoff counters: edge takes split "
+              "d2d vs host with bytes each class moved "
+              "(handoff-enabled runs only; d2d+host == edges, "
+              "host_bytes == 0 on device-resident edges)"),
+    StampSpec("Handoff edges:", "rnb_tpu/benchmark.py",
+              "JSON per-edge-label handoff counters "
+              "(handoff-enabled runs only)"),
+    StampSpec("Placement:", "rnb_tpu/benchmark.py",
+              "JSON measured-cost placement report: per-step dispatch "
+              "costs, predicted occupancy, recommended replica plan "
+              "(placement-enabled runs only; --check holds the "
+              "prediction to the traced busy fraction)"),
     StampSpec("Trace:", "rnb_tpu/benchmark.py",
               "trace-export counters: events written to trace.json, "
               "events dropped at the max_events cap "
@@ -217,6 +230,10 @@ TRACE_EVENT_REGISTRY = (
               "(sync_outputs)"),
     StampSpec("exec{step}.publish", "rnb_tpu/runner.py",
               "span: route + ring write + downstream enqueue"),
+    StampSpec("exec{step}.handoff", "rnb_tpu/runner.py",
+              "span: the edge contract's payload take — adopt or "
+              "reshard the committed upstream arrays onto this "
+              "consumer (handoff-enabled runs only)"),
     StampSpec("loader.decode_submit", "rnb_tpu/models/r2p1d/model.py",
               "instant: one request's decode submitted to the pool"),
     StampSpec("loader.decode", "rnb_tpu/models/r2p1d/model.py",

@@ -128,6 +128,25 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             # written only by trace-enabled runs (rnb_tpu.trace)
             import json
             meta["phases"] = json.loads(line.split(":", 1)[1])
+        elif line.startswith("Handoff edges:"):
+            # JSON per-edge-label handoff counters — written only by
+            # handoff-enabled runs (rnb_tpu.handoff)
+            import json
+            meta["handoff_edge_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Handoff:"):
+            # "Handoff: edges=E d2d_edges=D host_edges=H d2d_bytes=B
+            #  host_bytes=C" — device-resident handoff accounting,
+            # written only by handoff-enabled runs (rnb_tpu.handoff)
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["handoff_" + key] = int(val)
+        elif line.startswith("Placement:"):
+            # JSON measured-cost placement report (rnb_tpu.placement):
+            # per-step dispatch costs + predicted occupancy + the
+            # recommended replica plan — placement-enabled runs only
+            import json
+            meta["placement"] = json.loads(line.split(":", 1)[1])
         elif line.startswith("Failure reasons:"):
             import json
             meta["failure_reasons"] = json.loads(line.split(":", 1)[1])
@@ -746,6 +765,15 @@ def check_job(job_dir: str) -> List[str]:
                 "the full shape vocabulary"
                 % (step, int(sigs["steady_new"])))
 
+    # device-resident handoff accounting (rnb_tpu.handoff): every
+    # edge take has exactly one class, the per-edge detail must sum
+    # to the totals, and a device-resident config must have moved
+    # zero bytes through host memory
+    problems.extend(_check_handoff(job_dir, meta))
+    # measured-cost placement (rnb_tpu.placement): the executed
+    # plan's predicted occupancy must agree with the busy fraction
+    # the trace timeline actually recorded
+    problems.extend(_check_placement(job_dir, meta))
     # phase attribution (rnb_tpu.trace): the stamp-only decomposition
     # must partition every request's end-to-end span, cover every
     # steady row once per phase, and agree across its three surfaced
@@ -756,6 +784,133 @@ def check_job(job_dir: str) -> List[str]:
     # trace.json actually holds, and the artifact must be structurally
     # valid (every event stamped, every flow resolving)
     problems.extend(_check_trace_artifact(job_dir, meta))
+    return problems
+
+
+def _check_handoff(job_dir: str, meta: Dict[str, object]) -> List[str]:
+    problems: List[str] = []
+    if "handoff_edges" not in meta:
+        if "handoff_edge_detail" in meta:
+            problems.append("log-meta carries a 'Handoff edges:' line "
+                            "but no 'Handoff:' totals line")
+        return problems
+    for key in ("handoff_edges", "handoff_d2d_edges",
+                "handoff_host_edges", "handoff_d2d_bytes",
+                "handoff_host_bytes"):
+        if meta.get(key, 0) < 0:
+            problems.append("negative %s" % key)
+    d2d = meta.get("handoff_d2d_edges", 0)
+    host = meta.get("handoff_host_edges", 0)
+    edges = meta.get("handoff_edges", 0)
+    if d2d + host != edges:
+        problems.append(
+            "handoff_d2d_edges=%d + handoff_host_edges=%d != "
+            "handoff_edges=%d (every edge take has exactly one class)"
+            % (d2d, host, edges))
+    detail = meta.get("handoff_edge_detail", {})
+    if detail:
+        for total_key, field in (("handoff_d2d_edges", "d2d_edges"),
+                                 ("handoff_host_edges", "host_edges"),
+                                 ("handoff_d2d_bytes", "d2d_bytes"),
+                                 ("handoff_host_bytes", "host_bytes")):
+            summed = sum(int(dict(e).get(field, 0))
+                         for e in detail.values())
+            if summed != meta.get(total_key, 0):
+                problems.append(
+                    "'Handoff edges:' %s sums to %d but the 'Handoff:' "
+                    "line says %d" % (field, summed,
+                                      meta.get(total_key, 0)))
+    if _config_handoff_mode(job_dir) == "device" \
+            and meta.get("handoff_host_bytes", 0) != 0:
+        problems.append(
+            "handoff_host_bytes=%d on a device-resident config "
+            "(handoff.mode \"device\" promises zero host-hop bytes on "
+            "every edge)" % meta["handoff_host_bytes"])
+    return problems
+
+
+def _config_handoff_mode(job_dir: str) -> Optional[str]:
+    """The job's declared handoff mode from the config copy
+    benchmark.py drops into the job dir, or None when no config copy
+    declares an enabled ``handoff`` key."""
+    import json
+    for name in sorted(os.listdir(job_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(job_dir, name)) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(raw, dict) or "pipeline" not in raw:
+            continue
+        handoff = raw.get("handoff")
+        if isinstance(handoff, dict) and handoff.get("enabled", True):
+            return handoff.get("mode", "device")
+        return None
+    return None
+
+
+#: relative tolerance of the predicted-vs-traced occupancy check,
+#: with an absolute floor so near-idle stages (where scheduling noise
+#: dominates) don't flap
+_OCCUPANCY_REL_TOL = 0.25
+_OCCUPANCY_ABS_TOL = 0.05
+
+
+def _check_placement(job_dir: str,
+                     meta: Dict[str, object]) -> List[str]:
+    problems: List[str] = []
+    report = meta.get("placement")
+    if not report:
+        return problems
+    steps = dict(report).get("steps", {})
+    plan = dict(report).get("plan", {})
+    for key, entry in sorted(dict(plan).items()):
+        if int(dict(entry).get("replicas", 0)) < 1:
+            problems.append("'Placement:' plan for %s names %r "
+                            "replicas (must be >= 1)"
+                            % (key, dict(entry).get("replicas")))
+    # prediction vs trace: only checkable on trace-enabled runs whose
+    # artifact is complete (a dropped-events trace undercounts busy)
+    trace_path = os.path.join(job_dir, "trace.json")
+    if not os.path.isfile(trace_path) or "wall_time_s" not in meta \
+            or meta.get("trace_dropped", 0):
+        return problems
+    import json
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)
+    except ValueError:
+        return problems  # _check_trace_artifact reports unreadability
+    busy_us: Dict[int, float] = {}
+    span_re = re.compile(r"exec(\d+)\.(model_call|device_sync)$")
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        m = span_re.match(str(ev.get("name", "")))
+        if m:
+            step = int(m.group(1))
+            busy_us[step] = busy_us.get(step, 0.0) \
+                + float(ev.get("dur", 0.0))
+    wall = float(meta["wall_time_s"])
+    for key, entry in sorted(dict(steps).items()):
+        entry = dict(entry)
+        step_idx = int(key[4:])
+        if step_idx not in busy_us or wall <= 0.0:
+            continue
+        pred = float(entry.get("occupancy", 0.0))
+        instances = max(1, int(entry.get("instances", 1)))
+        traced = busy_us[step_idx] / 1e6 / wall / instances
+        tol = max(_OCCUPANCY_REL_TOL * traced, _OCCUPANCY_ABS_TOL)
+        if abs(pred - traced) > tol:
+            problems.append(
+                "'Placement:' %s predicts occupancy %.4f but the "
+                "trace records a %.4f busy fraction (tolerance "
+                "max(%d%%, %.2f)) — the planner's cost model drifted "
+                "from what the executors measured"
+                % (key, pred, traced,
+                   int(_OCCUPANCY_REL_TOL * 100), _OCCUPANCY_ABS_TOL))
     return problems
 
 

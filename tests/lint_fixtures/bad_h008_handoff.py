@@ -1,0 +1,15 @@
+"""RNB-H008: host materialization on a device-resident handoff path."""
+
+import numpy as np
+
+
+class DemoEdgeHandoff:
+    def __init__(self, device):
+        self.device = device
+
+    def take(self, payload):
+        out = []
+        for pb in payload:
+            host = np.asarray(pb)  # host bounce on the d2d path
+            out.append(host)
+        return tuple(out)

@@ -1,0 +1,23 @@
+"""Clean RNB-H008 fixture: host materialization confined to the
+designated host-mode path of a handoff class."""
+
+
+class DemoEdgeHandoff:
+    def __init__(self, device):
+        self.device = device
+
+    def take(self, payload):
+        # device-resident path: adopt/reshard only, no host bounce
+        out = []
+        for pb in payload:
+            out.append(self._rehome(pb))
+        return tuple(out)
+
+    def _rehome(self, pb):
+        import jax
+        return jax.device_put(pb, self.device)
+
+    def _take_host(self, payload):
+        # the designated host-mode arm: bouncing is its whole job
+        import numpy as np
+        return tuple(np.asarray(pb) for pb in payload)

@@ -1,0 +1,589 @@
+"""Device-resident handoff, replica-sharded serving, placement planner.
+
+Tier-1 coverage of the PR 9 scale-out contract on the 8-virtual-device
+CPU backend:
+
+* the ``rnb_tpu.ops.handoff_dma`` primitives — the ``shard_map`` /
+  ``ppermute`` CPU twin of the TPU remote-DMA kernel pins the ring
+  semantics, and the ring-shift pattern detector recognizes exactly
+  the placements the fast path may claim;
+* the ``EdgeHandoff`` take rules — adoption, on-device resharding,
+  the host-mode bounce — with **byte-parity of logits** across all
+  three edge shapes through a real (reduced-geometry) R(2+1)D
+  network stage;
+* ``replicas: N`` expansion + least-loaded routing end-to-end, with a
+  **contained-fault** run proving one replica's dead-lettered request
+  never strands or corrupts another replica's in-flight work;
+* the measured-cost placement planner: allocation math, the
+  ``Placement:`` report, apply-mode expansion, and the
+  predicted-vs-traced occupancy invariant through
+  ``parse_utils --check`` on a traced run.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import parse_utils  # noqa: E402
+
+from rnb_tpu.config import ConfigError, parse_config  # noqa: E402
+from rnb_tpu.handoff import (EdgeHandoff, HandoffSettings,  # noqa: E402
+                             InflightDepths, aggregate_snapshots)
+from rnb_tpu.selector import ReplicaSelector  # noqa: E402
+from rnb_tpu.stage import PaddedBatch, RaggedBatch  # noqa: E402
+
+
+def _devices():
+    import jax
+    return jax.devices()
+
+
+# -- handoff_dma: the DMA primitive pair ------------------------------
+
+def test_ring_shift_ppermute_twin_matches_roll():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from rnb_tpu.ops.handoff_dma import ring_shift
+    devs = _devices()
+    mesh = Mesh(np.array(devs), ("x",))
+    n = len(devs)
+    x = jnp.arange(n * 4 * 3, dtype=jnp.float32).reshape(n * 4, 3)
+    x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("x")))
+    for shift in (1, 3):
+        out = ring_shift(x, mesh, "x", shift=shift, use_pallas=False)
+        # shard of device i lands on device i+shift: value-wise a roll
+        # by shift shards along the sharded axis
+        want = jnp.roll(x, shift * 4, axis=0)
+        assert np.array_equal(np.asarray(out), np.asarray(want))
+    # shift 0 is the identity (no collective launched)
+    assert ring_shift(x, mesh, "x", shift=0, use_pallas=False) is x
+
+
+def test_ring_shift_amount_detects_rotations_only():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from rnb_tpu.ops.handoff_dma import ring_shift_amount
+    devs = _devices()
+    mesh = Mesh(np.array(devs), ("x",))
+    spec = PartitionSpec("x")
+    src = NamedSharding(mesh, spec)
+    for k in (1, 5):
+        rolled = Mesh(np.array(devs[k:] + devs[:k]), ("x",))
+        assert ring_shift_amount(src, NamedSharding(rolled, spec)) == k
+    # identity is not a shift
+    assert ring_shift_amount(src, src) is None
+    # different spec is not a shift
+    assert ring_shift_amount(
+        src, NamedSharding(mesh, PartitionSpec(None, "x"))) is None
+    # a non-rotation permutation is not a shift
+    shuffled = devs[:2][::-1] + devs[2:]
+    assert ring_shift_amount(
+        src, NamedSharding(Mesh(np.array(shuffled), ("x",)), spec)) \
+        is None
+    # plain devices (no sharding) are not the pattern
+    assert ring_shift_amount(None, src) is None
+
+
+def test_dma_gate_is_off_on_cpu():
+    from rnb_tpu.ops.handoff_dma import dma_available
+    assert dma_available() is False
+
+
+# -- EdgeHandoff take rules -------------------------------------------
+
+def _settings(mode):
+    return HandoffSettings.from_config({"mode": mode})
+
+
+def test_device_mode_adopts_resident_arrays_by_reference():
+    import jax
+    dev = _devices()[1]
+    data = jax.device_put(np.ones((4, 3), np.float32), dev)
+    pb = PaddedBatch(data, 2)
+    ho = EdgeHandoff(_settings("device"), dev, "step0->step1")
+    (out,) = ho.take((pb,))
+    assert out is pb  # adopted, not copied
+    snap = ho.snapshot()
+    assert snap["d2d_edges"] == 1 and snap["host_edges"] == 0
+    assert snap["d2d_bytes"] == 0 and snap["host_bytes"] == 0
+
+
+def test_device_mode_reshards_cross_device_without_host_bytes():
+    import jax
+    src, dst = _devices()[0], _devices()[2]
+    data = jax.device_put(
+        np.arange(12, dtype=np.float32).reshape(4, 3), src)
+    pb = RaggedBatch(data, 3, (0, 1, 3))
+    ho = EdgeHandoff(_settings("device"), dst, "step0->step1")
+    (out,) = ho.take((pb,))
+    assert isinstance(out, RaggedBatch)
+    assert out.segment_offsets == (0, 1, 3) and out.valid == 3
+    assert out.data.devices() == {dst}
+    assert np.array_equal(np.asarray(out.data), np.asarray(data))
+    snap = ho.snapshot()
+    assert snap["d2d_edges"] == 1 and snap["d2d_bytes"] == data.nbytes
+    assert snap["host_bytes"] == 0
+
+
+def test_host_mode_counts_every_bounced_byte():
+    import jax
+    src, dst = _devices()[0], _devices()[1]
+    data = jax.device_put(np.ones((4, 3), np.float32), src)
+    ho = EdgeHandoff(_settings("host"), dst, "step0->step1")
+    (out,) = ho.take((PaddedBatch(data, 4),))
+    assert out.data.devices() == {dst}
+    snap = ho.snapshot()
+    assert snap["host_edges"] == 1 and snap["host_bytes"] == data.nbytes
+    assert snap["d2d_edges"] == 0 and snap["d2d_bytes"] == 0
+
+
+def test_aggregate_snapshots_partitions_and_details():
+    snaps = [
+        {"edge": "step0->step1", "mode": "device", "d2d_edges": 3,
+         "host_edges": 0, "d2d_bytes": 300, "host_bytes": 0},
+        {"edge": "step0->step1", "mode": "device", "d2d_edges": 2,
+         "host_edges": 0, "d2d_bytes": 200, "host_bytes": 0},
+        {"edge": "step1->step2", "mode": "host", "d2d_edges": 0,
+         "host_edges": 4, "d2d_bytes": 0, "host_bytes": 400},
+    ]
+    agg = aggregate_snapshots(snaps)
+    assert agg["edges"] == agg["d2d_edges"] + agg["host_edges"] == 9
+    assert agg["edge_detail"]["step0->step1"]["d2d_edges"] == 5
+    assert agg["edge_detail"]["step1->step2"]["host_bytes"] == 400
+
+
+def test_logit_byte_parity_across_edge_shapes():
+    """The headline contract: host-hop, device-resident adoption and
+    cross-device resharding deliver bit-identical logits through the
+    real network stage."""
+    import jax
+
+    from rnb_tpu.models.r2p1d.model import R2P1DRunner
+    devs = _devices()
+    net_dev = devs[1]
+    runner = R2P1DRunner(net_dev, start_index=1, end_index=5,
+                         num_classes=8, layer_sizes=(1, 1, 1, 1),
+                         max_rows=2, consecutive_frames=2,
+                         num_warmups=1)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    host = rng.random((2, 2, 112, 112, 3), np.float32)
+    base = jax.device_put(jnp.asarray(host, jnp.bfloat16), devs[0])
+
+    from rnb_tpu.telemetry import TimeCard
+
+    def logits_via(ho, home):
+        (pb,) = ho.take((PaddedBatch(jax.device_put(base, home), 2),))
+        (out,), _, _ = runner((pb,), None, TimeCard(0))
+        return np.asarray(out.data, np.float32)
+
+    got = [
+        logits_via(EdgeHandoff(_settings("host"), net_dev, "e"),
+                   devs[0]),
+        logits_via(EdgeHandoff(_settings("device"), net_dev, "e"),
+                   devs[0]),   # cross-device reshard
+        logits_via(EdgeHandoff(_settings("device"), net_dev, "e"),
+                   net_dev),   # same-device adoption
+    ]
+    assert np.array_equal(got[0], got[1])
+    assert np.array_equal(got[0], got[2])
+
+
+# -- replica routing machinery ----------------------------------------
+
+def test_inflight_depths_and_replica_selector_least_loaded():
+    depths = InflightDepths((4, 5, 6))
+    sel = ReplicaSelector(3)
+    sel.bind_depths(depths, [4, 5, 6])
+    # empty lanes: deterministic lowest index
+    assert sel.select(None, None, None) == 0
+    depths.inc(4)
+    assert sel.select(None, None, None) == 1
+    depths.inc(5)
+    depths.inc(5)
+    # lane 6 (position 2) is now emptiest
+    assert sel.select(None, None, None) == 2
+    depths.dec(5, 2)
+    assert sel.select(None, None, None) == 1
+    # unbound: degrades to round-robin
+    free = ReplicaSelector(2)
+    assert [free.select(None, None, None) for _ in range(4)] \
+        == [0, 1, 0, 1]
+    with pytest.raises(ValueError):
+        sel.bind_depths(depths, [4, 5])  # arity mismatch
+
+
+def test_replica_expansion_rejects_bad_topologies():
+    def cfg(step1_extra=None, root_extra=None):
+        raw = {
+            "video_path_iterator": "x.Y",
+            "pipeline": [
+                {"model": "a.B",
+                 "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+                dict({"model": "c.D", "queue_groups": [
+                    {"devices": [1, 2], "in_queue": 0}]},
+                    **(step1_extra or {})),
+            ],
+        }
+        raw.update(root_extra or {})
+        return raw
+
+    # replicas must divide the device list
+    with pytest.raises(ConfigError):
+        parse_config(cfg({"replicas": 3}))
+    # first step cannot replicate by lanes
+    bad = cfg()
+    bad["pipeline"][0]["replicas"] = 2
+    bad["pipeline"][0]["queue_groups"][0]["devices"] = [0, 1]
+    with pytest.raises(ConfigError):
+        parse_config(bad)
+    # segments and replica lanes do not compose
+    with pytest.raises(ConfigError):
+        parse_config(cfg({"replicas": 2, "num_segments": 2}))
+    # placement apply needs a plan naming in-range steps
+    with pytest.raises(ConfigError):
+        parse_config(cfg(root_extra={"placement": {
+            "mode": "apply", "plan": {"step9": 2}}}))
+    with pytest.raises(ConfigError):
+        parse_config(cfg(root_extra={"placement": {"mode": "apply"}}))
+    # bad handoff mode
+    with pytest.raises(ConfigError):
+        parse_config(cfg(root_extra={"handoff": {"mode": "dma"}}))
+    # replicas: 1 is a no-op (single lane-less group survives)
+    cfg1 = parse_config(cfg({"replicas": 1}))
+    assert cfg1.steps[1].replica_queues is None
+    assert len(cfg1.steps[1].groups) == 1
+
+
+def test_placement_apply_expands_and_step_key_wins():
+    raw = {
+        "video_path_iterator": "x.Y",
+        "placement": {"mode": "apply", "plan": {"step1": 2}},
+        "pipeline": [
+            {"model": "a.B",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "c.D", "queue_groups": [
+                {"devices": [1, 2], "in_queue": 0}]},
+        ],
+    }
+    cfg = parse_config(raw)
+    assert len(cfg.steps[1].groups) == 2
+    assert cfg.steps[1].replica_queues == (1, 2)
+    assert cfg.steps[0].groups[0].queue_selector \
+        == "rnb_tpu.selector.ReplicaSelector"
+    # an explicit step replicas key overrides the plan
+    raw["pipeline"][1]["replicas"] = 1
+    cfg = parse_config(json.loads(json.dumps(raw)))
+    assert cfg.steps[1].replica_queues is None
+
+
+# -- placement planner math -------------------------------------------
+
+def test_recommend_minimizes_bottleneck_occupancy():
+    from rnb_tpu.placement import recommend
+    # step 1 carries 4x the load of step 0: the budget goes there
+    plan = recommend({0: 0.2, 1: 0.8}, device_budget=5)
+    assert plan[0] + plan[1] == 5
+    assert plan[1] > plan[0]
+    # zero-load steps never absorb budget beyond their single device
+    plan = recommend({0: 0.0, 1: 0.5}, device_budget=8)
+    assert plan[0] == 1
+    # deterministic on ties: lowest step first
+    assert recommend({0: 0.5, 1: 0.5}, 3) == {0: 2, 1: 1}
+
+
+def test_build_report_predicts_executed_plan_occupancy():
+    from rnb_tpu.placement import CostRecord, build_report
+    records = [CostRecord(0, 2.0, 10), CostRecord(1, 4.0, 10),
+               CostRecord(1, 4.0, 10)]
+    report = build_report(records, wall_s=10.0, device_budget=8,
+                          mode="plan")
+    s0, s1 = report["steps"]["step0"], report["steps"]["step1"]
+    assert s0["instances"] == 1 and s1["instances"] == 2
+    # occupancy == busy / (wall * instances) by construction
+    assert abs(s0["occupancy"] - 0.2) < 1e-6
+    assert abs(s1["occupancy"] - 0.4) < 1e-6
+    assert report["plan"]["step1"]["replicas"] \
+        >= report["plan"]["step0"]["replicas"]
+    assert build_report([], 10.0, 8, "plan") is None
+
+
+# -- end-to-end: replicas + handoff + placement -----------------------
+
+def _tiny_config(**root):
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "tests.pipeline_helpers.TinyDouble",
+             "replicas": 2,
+             "queue_groups": [{"devices": [1, 2], "in_queue": 0,
+                               "out_queues": [1]}]},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [0], "in_queue": 1}]},
+        ],
+    }
+    cfg.update(root)
+    return cfg
+
+
+def _run(cfg, videos=12, **kwargs):
+    from rnb_tpu.benchmark import run_benchmark
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "cfg.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        res = run_benchmark(path, mean_interval_ms=0,
+                            num_videos=videos, queue_size=64,
+                            log_base=tmp, print_progress=False,
+                            seed=5, **kwargs)
+        problems = parse_utils.check_job(res.log_dir)
+        meta = parse_utils.parse_meta(res.log_dir)
+        tables = [parse_utils.parse_timing_table(p) for p in
+                  parse_utils._timing_tables(res.log_dir)]
+        with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+            meta_text = f.read()
+        return res, problems, meta, tables, meta_text
+
+
+def test_e2e_device_handoff_replicas_and_placement():
+    res, problems, meta, tables, _text = _run(_tiny_config(
+        handoff={"mode": "device"}, placement={"mode": "plan"}))
+    assert problems == [], problems
+    assert res.termination_flag == 0 and res.num_completed == 12
+    # every inter-stage take accounted, none through host memory
+    assert res.handoff_edges == res.handoff_d2d_edges == 24
+    assert res.handoff_host_edges == 0
+    assert res.handoff_host_bytes == 0
+    assert meta["handoff_edges"] == 24
+    assert set(res.handoff_edge_detail) \
+        == {"step0->step1", "step1->step2"}
+    # the plan line reports every step with its executed instances
+    assert set(res.placement["steps"]) == {"step0", "step1", "step2"}
+    assert res.placement["steps"]["step1"]["instances"] == 2
+    assert meta["placement"] == res.placement
+
+
+def test_e2e_host_mode_counts_host_bytes():
+    res, problems, _meta, _tables, _text = _run(_tiny_config(
+        handoff={"mode": "host"}))
+    assert problems == [], problems
+    assert res.handoff_host_edges == res.handoff_edges == 24
+    assert res.handoff_host_bytes > 0
+    assert res.handoff_d2d_bytes == 0
+
+
+def test_e2e_handoff_off_keeps_logs_byte_stable():
+    res, problems, meta, _tables, meta_text = _run(_tiny_config())
+    assert problems == [], problems
+    assert "handoff_edges" not in meta and "placement" not in meta
+    assert "Handoff" not in meta_text and "Placement" not in meta_text
+
+
+def test_e2e_final_step_replicas_share_load():
+    """Replicas on the FINAL step: least-loaded lanes both serve, and
+    every completion lands in exactly one replica's table."""
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "handoff": {"mode": "device"},
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "tests.pipeline_helpers.TinySink", "replicas": 2,
+             "queue_groups": [{"devices": [1, 2], "in_queue": 0}]},
+        ],
+    }
+    res, problems, _meta, tables, _text = _run(cfg, videos=16)
+    assert problems == [], problems
+    assert res.termination_flag == 0 and res.num_completed == 16
+    assert len(tables) == 2
+    rows = [len(t) for t in tables]
+    assert sum(rows) == 16
+    assert all(r > 0 for r in rows), (
+        "least-loaded routing starved a replica lane: %s" % rows)
+
+
+def test_e2e_contained_fault_on_one_replica_spares_the_others():
+    """A request dead-lettered on one replica must not strand or
+    corrupt any other replica's in-flight work: the run terminates at
+    its target, every surviving request completes exactly once, and
+    the failure is attributed to the replica step."""
+    cfg = _tiny_config(fault_plan={"faults": [
+        {"kind": "permanent", "step": 1, "request_ids": [3],
+         "reason": "chaos-replica"}]})
+    cfg["handoff"] = {"mode": "device"}
+    res, problems, _meta, tables, _text = _run(cfg, videos=12)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    assert res.num_failed == 1 and res.num_completed == 11
+    assert res.failure_reasons == {"chaos-replica": 1}
+    assert sum(len(t) for t in tables) == 11
+
+
+def test_e2e_traced_placement_prediction_matches_occupancy():
+    """The planner's predicted occupancy must survive the --check
+    comparison against the trace timeline's busy fraction — with an
+    injected-latency step so the occupancy is real, not noise."""
+    cfg = _tiny_config(
+        handoff={"mode": "device"},
+        placement={"mode": "plan"},
+        trace={"enabled": True, "sample_hz": 0},
+        fault_plan={"faults": [
+            {"kind": "latency", "step": 1, "probability": 1.0,
+             "ms": 20}]},
+    )
+    res, problems, _meta, _tables, _text = _run(cfg, videos=10)
+    # check_job above ran _check_placement against the real trace
+    # artifact: an out-of-tolerance prediction would be in problems
+    assert problems == [], problems
+    occ = res.placement["steps"]["step1"]["occupancy"]
+    # 2 replicas x 10 dispatches x >=20 ms over the short window:
+    # clearly nonzero — so the comparison above had teeth
+    assert occ > 0.05
+
+
+def test_check_flags_handoff_partition_violation(tmp_path):
+    job = tmp_path / "job"
+    job.mkdir()
+    (job / "log-meta.txt").write_text(
+        "Args: Namespace(mean_interval_ms=0, batch_size=1, videos=1, "
+        "queue_size=1, config_file_path='x.json')\n"
+        "1.0 2.0\n"
+        "Termination flag: 0\n"
+        "Faults: num_failed=0 num_shed=0 num_retries=0\n"
+        "Handoff: edges=5 d2d_edges=3 host_edges=1 d2d_bytes=10 "
+        "host_bytes=4\n")
+    problems = parse_utils._check_handoff(
+        str(job), parse_utils.parse_meta(str(job)))
+    assert any("exactly one class" in p for p in problems)
+
+
+def test_check_flags_host_bytes_on_device_config(tmp_path):
+    job = tmp_path / "job"
+    job.mkdir()
+    (job / "cfg.json").write_text(json.dumps(
+        {"video_path_iterator": "x.Y", "handoff": {"mode": "device"},
+         "pipeline": [{"model": "a.B", "queue_groups": []}]}))
+    (job / "log-meta.txt").write_text(
+        "Termination flag: 0\n"
+        "Handoff: edges=2 d2d_edges=1 host_edges=1 d2d_bytes=10 "
+        "host_bytes=64\n")
+    problems = parse_utils._check_handoff(
+        str(job), parse_utils.parse_meta(str(job)))
+    assert any("zero host-hop bytes" in p for p in problems)
+
+
+def test_device_mode_honors_declared_input_sharding():
+    """A stage declaring input_sharding() (the mesh runner's
+    protocol) gets its payloads re-homed onto that sharding by the
+    edge take — mesh-replicated here — with the move counted d2d."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = _devices()[:2]
+    mesh = Mesh(np.array(devs), ("x",))
+    target = NamedSharding(mesh, PartitionSpec())
+
+    class MeshStage:
+        def input_sharding(self):
+            return target
+
+    data = jax.device_put(np.arange(6, dtype=np.float32).reshape(2, 3),
+                          _devices()[3])
+    ho = EdgeHandoff(_settings("device"), _devices()[0], "e",
+                     MeshStage())
+    (out,) = ho.take((PaddedBatch(data, 2),))
+    assert out.data.sharding == target
+    assert out.data.devices() == set(devs)
+    assert np.array_equal(np.asarray(out.data), np.asarray(data))
+    snap = ho.snapshot()
+    assert snap["d2d_edges"] == 1 and snap["host_bytes"] == 0
+    # a payload already on the declared sharding is adopted
+    (again,) = ho.take((out,))
+    assert again is out
+    assert ho.snapshot()["d2d_bytes"] == data.nbytes  # no second move
+
+
+def test_batcher_fuses_identically_sharded_payloads_on_device():
+    """Equal shardings — not merely one device — take the lazy jnp
+    fuse path, so mesh-resident payloads delivered by the edge
+    contract never bounce through the host-numpy fallback."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from rnb_tpu.batcher import Batcher
+    devs = _devices()[:2]
+    sharding = NamedSharding(Mesh(np.array(devs), ("x",)),
+                             PartitionSpec())
+    parts = [PaddedBatch(jax.device_put(
+        jnp.full((2, 3), float(i)), sharding), 1) for i in range(2)]
+    fused = Batcher._fuse_parts(parts, valid=2, bucket=4)
+    assert isinstance(fused.data, jax.Array)
+    assert fused.valid == 2
+    want = np.zeros((4, 3), np.float32)
+    want[0], want[1] = 0.0, 1.0
+    assert np.array_equal(np.asarray(fused.data, np.float32), want)
+
+
+def test_carve_replicas_contiguous_equal_submeshes():
+    from rnb_tpu.parallel.mesh import carve_replicas
+    assert carve_replicas([1, 2, 3, 4], 2) == [[1, 2], [3, 4]]
+    assert carve_replicas([1, 2, 3, 4], 4) == [[1], [2], [3], [4]]
+    assert carve_replicas([7], 1) == [[7]]
+    with pytest.raises(ValueError):
+        carve_replicas([1, 2, 3], 2)
+    with pytest.raises(ValueError):
+        carve_replicas([], 1)
+
+
+def test_batcher_fuses_mixed_sharding_classes_on_one_device():
+    """A NamedSharding over a 1-device mesh and a SingleDeviceSharding
+    on that same device fuse on the device path — sharding-object
+    inequality must not force the host-numpy bounce."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from rnb_tpu.batcher import Batcher
+    dev = _devices()[1]
+    named = NamedSharding(Mesh(np.array([dev]), ("x",)),
+                          PartitionSpec())
+    parts = [
+        PaddedBatch(jax.device_put(jnp.full((2, 3), 1.0), named), 1),
+        PaddedBatch(jax.device_put(jnp.full((2, 3), 2.0), dev), 1),
+    ]
+    assert parts[0].data.sharding != parts[1].data.sharding
+    fused = Batcher._fuse_parts(parts, valid=2, bucket=3)
+    assert isinstance(fused.data, jax.Array)
+    want = np.array([[1.0] * 3, [2.0] * 3, [0.0] * 3], np.float32)
+    assert np.array_equal(np.asarray(fused.data, np.float32), want)
+
+
+def test_replicas_one_still_validates_structure():
+    """'replicas: 1' must enforce the same structural constraints as
+    any other count — an operator iterating replica counts must not
+    hit a 'regression' at 2 for a topology that was invalid at 1."""
+    raw = {
+        "video_path_iterator": "x.Y",
+        "pipeline": [
+            {"model": "a.B", "replicas": 1,
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "c.D",
+             "queue_groups": [{"devices": [1], "in_queue": 0}]},
+        ],
+    }
+    with pytest.raises(ConfigError):
+        parse_config(raw)  # first step cannot carry the key, even at 1

@@ -144,12 +144,21 @@ HOTPATH_CASES = [
     ("bad_h005_shed.py", "RNB-H005"),
     ("bad_h006_sync.py", "RNB-H006"),
     ("bad_h007_alloc.py", "RNB-H007"),
+    ("bad_h008_handoff.py", "RNB-H008"),
 ]
 
 
 def test_good_hotpath_fixture_is_clean():
     from rnb_tpu.analysis.hotpath import check_file
     assert check_file(_fixture("good_hot.py"), root=FIXTURES) == []
+
+
+def test_good_handoff_fixture_is_clean():
+    # host materialization confined to the '*host*'-named path of a
+    # Handoff class is the sanctioned shape (rnb_tpu.handoff's own
+    # _take_host); RNB-H008 must stay quiet on it
+    from rnb_tpu.analysis.hotpath import check_file
+    assert check_file(_fixture("good_handoff.py"), root=FIXTURES) == []
 
 
 @pytest.mark.parametrize("name,rule", HOTPATH_CASES)
@@ -270,6 +279,9 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Phases: %s\\n" % p)\n'
                      'f.write("Ragged: pool_rows=%d\\n" % r)\n'
                      'f.write("Padding: pad_rows=%d\\n" % pd)\n'
+                     'f.write("Handoff: edges=%d\\n" % ho)\n'
+                     'f.write("Handoff edges: %s\\n" % he)\n'
+                     'f.write("Placement: %s\\n" % pl)\n'
                      'f.write("Compiles: %s\\n" % c)\n'
                      'f.write("Warmup: %s\\n" % w)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
@@ -309,7 +321,9 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'f.write("Ragged: pool_rows=%d emissions=%d rows=%d '
         'pad_rows_eliminated=%d cache_hit_rows=%d\\n" % r)\n'
         'f.write("Padding: pad_rows=%d total_rows=%d '
-        'pad_emissions=%d\\n" % p)\n')
+        'pad_emissions=%d\\n" % p)\n'
+        'f.write("Handoff: edges=%d d2d_edges=%d host_edges=%d '
+        'd2d_bytes=%d host_bytes=%d\\n" % h)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}

@@ -60,6 +60,42 @@ def test_every_shipped_config_has_an_ok_execution_row():
     assert artifact["all_ok"] is True
 
 
+def test_scaleout_arms_ship_executed_and_scale():
+    """The PR 9 replica/handoff arms must land in BOTH configs/ and
+    the matrix (the two-way sync tests above enforce the general
+    rule; this pins the specific pair), and the committed execution
+    rows must back the headline claim: the 4-replica arm >= 2.5x the
+    single-replica same-workload arm. A re-sweep that drops below the
+    floor invalidates the headline and must fail here, not silently
+    rot in the artifact (`make multichip` asserts the same bound
+    end-to-end with --check)."""
+    arms = ("configs/rnb-scaleout-r1.json",
+            "configs/rnb-scaleout-r4.json")
+    for rel in arms:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+        from rnb_tpu.config import load_config
+        cfg = load_config(os.path.join(REPO, rel))
+        # both arms declare device-resident handoff + the planner
+        assert cfg.handoff and cfg.handoff.get("mode") == "device"
+        assert cfg.placement is not None
+    # the apply arm really expands to 4 replica lanes
+    r4_cfg = load_config(os.path.join(REPO, arms[1]))
+    assert r4_cfg.steps[1].replica_queues is not None
+    assert len(r4_cfg.steps[1].replica_queues) == 4
+    with open(ARTIFACT) as f:
+        rows = {r["config"]: r
+                for r in json.load(f)["configs"]}
+    for rel in arms:
+        assert rows[rel].get("ok"), rel
+    ratio = (rows[arms[1]]["videos_per_sec"]
+             / rows[arms[0]]["videos_per_sec"])
+    assert ratio >= 2.5, (
+        "committed scale-out rows show only %.2fx (4-replica vs "
+        "1-replica); the headline requires >= 2.5x — re-run "
+        "scripts/run_shipped_configs.py --only 'rnb-scaleout-*' on an "
+        "idle host or retune the arms" % ratio)
+
+
 def test_every_executed_config_is_still_shipped():
     """The reverse direction: MULTICHIP_CONFIGS.json and configs/ stay
     in sync BOTH ways. A row for a config that no longer ships is a
