@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace ragged multichip
+.PHONY: lint test native stamps trace ragged multichip chaos
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -42,6 +42,16 @@ ragged:
 # planner's predicted-vs-traced occupancy comparison).
 multichip:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/multichip_demo.py
+
+# Replica-loss chaos gate (README "Self-healing & chaos"): seeded
+# mid-stream kill of 1 of 4 replica lanes on the shipped chaos arm,
+# asserting every request terminates exactly once (completed /
+# dead-lettered / shed), the dead lane is evicted with its queued work
+# redispatched onto healthy siblings, the selector never routes to it
+# after circuit-open, and parse_utils --check is green including the
+# Health:/Deadline:/Hedge: invariants. Exit 0 = containment holds.
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_demo.py
 
 native:
 	$(MAKE) -C native

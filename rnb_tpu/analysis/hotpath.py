@@ -47,6 +47,15 @@ Rules
   anti-pattern PR 4 removed; decode into a ``rnb_tpu.staging``
   StagingPool slot instead, and baseline the copy fallback with its
   justification.
+* ``RNB-H009`` unbounded-blocking-wait: a no-argument ``.get()`` /
+  ``.wait()`` / ``.acquire()`` / ``.result()`` call without a
+  ``timeout`` keyword in an executor/stage hot path (or any ``wait``
+  method, the blocking leaves hot paths call through) — a consumer
+  blocked forever on a dead producer's queue/event hangs the drain
+  path past every containment mechanism. Bound the wait and re-check
+  liveness (termination flag, pool failure, deadline) each lap, or
+  baseline the site with the justification for why it cannot hang
+  (e.g. a Barrier carrying a construction-time timeout).
 * ``RNB-H008`` host-materialization-on-device-edge: a host
   materialization call (``device_get``, ``np.asarray``/``np.array``,
   ``.copy_to_host_async``, ``.tolist``) inside a device-resident
@@ -347,6 +356,55 @@ def _lint_hot_body(rel: str, qual: str, node,
                     % alloc))
 
 
+#: attribute names whose NO-ARGUMENT call blocks until someone else
+#: acts — with no timeout, forever (dict.get and Queue.get(key-ish)
+#: take positional args, so zero-arg calls are the queue/event/lock/
+#: future shapes)
+_H009_BLOCKING_ATTRS = {"get", "wait", "acquire", "result"}
+
+
+def _unbounded_wait_kind(node: ast.Call) -> Optional[str]:
+    """Classify one call as an unbounded blocking wait, or None."""
+    f = node.func
+    if not isinstance(f, ast.Attribute) \
+            or f.attr not in _H009_BLOCKING_ATTRS:
+        return None
+    if node.args:
+        return None  # positional args: dict.get(key), pool.wait(t)
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return None
+    return ".%s()" % f.attr
+
+
+def _lint_unbounded_waits(rel: str, index: _ModuleIndex,
+                          findings: List[Finding],
+                          hot: Set[str]) -> None:
+    """RNB-H009 over the hot set plus every ``wait`` method — the
+    blocking leaf hot paths call through cross-object (the intra-
+    module call graph cannot follow ``handle.wait()``), so the leaves
+    are linted under their own anchors."""
+    scope = set(hot)
+    for qual in index.functions:
+        name = qual.rsplit(".", 1)[-1]
+        if name == "wait":
+            scope.add(qual)
+    for qual in sorted(scope):
+        node = index.functions.get(qual)
+        if node is None:
+            continue
+        for sub in _own_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            kind = _unbounded_wait_kind(sub)
+            if kind is not None:
+                findings.append(Finding(
+                    "RNB-H009", rel, sub.lineno, qual,
+                    "%s without a timeout on a hot/blocking path — a "
+                    "dead counterpart hangs this thread forever; "
+                    "bound the wait and re-check liveness each lap, "
+                    "or baseline it with the justification" % kind))
+
+
 def _lint_fault_determinism(rel: str, index: _ModuleIndex,
                             findings: List[Finding]) -> None:
     is_faults_module = os.path.basename(rel) == "faults.py"
@@ -475,9 +533,11 @@ def check_file(path: str, root: str = ".") -> List[Finding]:
     for qual in sorted(jit_quals):
         _lint_jit_body(rel, qual, index.functions[qual], findings)
 
-    for qual in sorted(_hot_set(index, rel) - jit_quals):
+    hot = _hot_set(index, rel)
+    for qual in sorted(hot - jit_quals):
         _lint_hot_body(rel, qual, index.functions[qual], findings)
 
+    _lint_unbounded_waits(rel, index, findings, hot)
     _lint_fault_determinism(rel, index, findings)
     _lint_shed_ordering(rel, index, findings)
     _lint_handoff_device_paths(rel, index, findings)

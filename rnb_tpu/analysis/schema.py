@@ -237,7 +237,10 @@ COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Trace:": "trace_",
                          "Ragged:": "ragged_",
                          "Handoff:": "handoff_",
-                         "Padding:": ""}
+                         "Padding:": "",
+                         "Health:": "health_",
+                         "Deadline:": "deadline_",
+                         "Hedge:": "hedges_"}
 
 #: verbatim-named counter fields (prefix "") the reverse RNB-T006
 #: direction holds to a meta-line counter — the Faults: trio plus the
@@ -456,7 +459,10 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
                 or field.startswith("autotune_") \
                 or field.startswith("trace_") \
                 or field.startswith("ragged_") \
-                or field.startswith("handoff_"):
+                or field.startswith("handoff_") \
+                or field.startswith("health_") \
+                or field.startswith("deadline_") \
+                or field.startswith("hedges_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,

@@ -20,6 +20,8 @@ import numpy as np
 
 from rnb_tpu import trace
 from rnb_tpu.autotune import BatchController
+from rnb_tpu.health import cards_of as _cards_of
+from rnb_tpu.health import expired as _deadline_expired
 from rnb_tpu.ops.ragged import resolve_pool_rows, segment_offsets_of
 from rnb_tpu.stage import (PadCounter, PaddedBatch, RaggedBatch,
                            StageModel, normalize_row_buckets,
@@ -102,6 +104,10 @@ class Batcher(StageModel):
         #: by the executor via enable_autotune(); None = static
         #: accumulate-to-`batch` semantics exactly as configured
         self.autotune = None
+        #: deadline-expired requests dropped from the accumulator at
+        #: emission time (rnb_tpu.health), parked for the executor's
+        #: take_shed() drain — inert unless requests carry deadlines
+        self._shed = []
         #: monotonic instant the oldest pending request joined the
         #: accumulator (None when empty) — the hold-deadline anchor
         self._t_oldest = None
@@ -273,7 +279,51 @@ class Batcher(StageModel):
                     return bucket
         return self._declared_max[0]
 
+    def take_shed(self):
+        """Executor hook (rnb_tpu.runner): requests this stage shed
+        internally because their deadline expired while the batch
+        accumulated -> [(card, where)] (drained each loop top)."""
+        out, self._shed = self._shed, []
+        return out
+
+    def _drop_expired(self) -> None:
+        """The 'Batcher emit' deadline boundary (rnb_tpu.health): a
+        request whose absolute deadline passed while it waited in the
+        accumulator is dropped BEFORE fusing — its rows never pad a
+        dispatch, never burn downstream service. Inert when no card
+        carries a deadline stamp."""
+        if not any(getattr(tc, "deadline_s", None) is not None
+                   for item in self._time_cards
+                   for tc in _cards_of(item)):
+            # no constituent card anywhere carries a deadline (the
+            # unwrap matters: an upstream fusing loader delivers
+            # TimeCardLists whose deadline stamps live on the
+            # constituents, not the wrapper)
+            return
+        live_tensors, live_cards = [], []
+        for tensors, card in zip(self._tensors, self._time_cards):
+            # forked segment cards are never shed — same rule as every
+            # other shed boundary (runner take/publish): dropping one
+            # segment would strand its aggregator siblings forever and
+            # count the request toward the target a second time
+            forked = any(getattr(tc, "sub_id", None) is not None
+                         for tc in _cards_of(card))
+            if not forked and _deadline_expired(card):
+                self._shed.append((card, "hold"))
+            else:
+                live_tensors.append(tensors)
+                live_cards.append(card)
+        self._tensors = live_tensors
+        self._time_cards = live_cards
+
     def _emit_fused(self):
+        self._drop_expired()
+        if not self._time_cards:
+            # every pending request expired: nothing to emit — the
+            # executor's take_shed() drain disposes the parked cards
+            self._tensors = []
+            self._t_oldest = None
+            return None, None, None
         if trace.ACTIVE is not None:
             # timeline marker per fused dispatch (args allocated only
             # while tracing): how many requests/rows this batch fused

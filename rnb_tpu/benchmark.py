@@ -196,6 +196,40 @@ class BenchmarkResult:
     #: the `Placement:` JSON meta line verbatim. Empty without the
     #: root `placement` config key.
     placement: Dict[str, Any] = field(default_factory=dict)
+    #: lane health / circuit-breaker accounting (rnb_tpu.health,
+    #: root `health` config key), summed over every replica step's
+    #: board; all zero without the key. transitions counts every
+    #: state-machine hop; evictions counts permanently dead lanes;
+    #: redispatches counts items drained off evicted lanes onto
+    #: healthy siblings; routes_after_open counts containment
+    #: violations (routes to an open/evicted lane while a routable
+    #: sibling existed) and must be 0 on a healthy run.
+    health_lanes: int = 0
+    health_transitions: int = 0
+    health_opens: int = 0
+    health_evictions: int = 0
+    health_probes: int = 0
+    health_redispatches: int = 0
+    health_routes_after_open: int = 0
+    #: per-lane health detail (the `Health lanes:` JSON meta line):
+    #: final state, full transition path, redispatched-from count
+    health_lane_detail: Dict[str, Any] = field(default_factory=dict)
+    #: deadline-propagation accounting (rnb_tpu.health, root
+    #: `deadline` config key): the configured budget and requests
+    #: shed as deadline_expired across every check site; zero/empty
+    #: without the key
+    deadline_budget_ms: int = 0
+    deadline_expired: int = 0
+    deadline_sites: Dict[str, int] = field(default_factory=dict)
+    #: hedged re-dispatch accounting (rnb_tpu.health, step key
+    #: `hedge_ms`): fired re-issues, wins by the hedge copy, losses
+    #: (original resolved first), and the losers' burned service
+    #: milliseconds — won + lost == fired always; hedge work is
+    #: counted here as overhead, never in throughput_vps
+    hedges_fired: int = 0
+    hedges_won: int = 0
+    hedges_lost: int = 0
+    hedges_wasted_ms: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -334,12 +368,53 @@ def run_benchmark(config_path: str,
         step_idx: InflightDepths(step.replica_queues)
         for step_idx, step in enumerate(config.steps)
         if step.replica_queues}
+    # self-healing layer (rnb_tpu.health): lane health boards per
+    # replica step (root 'health' key), the job-wide deadline ledger
+    # (root 'deadline' key; budget seeded from autotune.slo_ms), and
+    # hedge governors per replicated edge ('hedge_ms' step key)
+    from rnb_tpu.health import (DeadlineSettings, DeadlineStats,
+                                HealthSettings, HedgeGovernor,
+                                LaneHealthBoard)
+    health_settings = HealthSettings.from_config(config.health)
+    boards_by_step: Dict[int, LaneHealthBoard] = {}
+    if health_settings is not None:
+        boards_by_step = {
+            step_idx: LaneHealthBoard(step.replica_queues,
+                                      health_settings)
+            for step_idx, step in enumerate(config.steps)
+            if step.replica_queues}
+        if not boards_by_step:
+            print("[rnb-tpu] WARNING: health is enabled but no step "
+                  "declares replica lanes — there is nothing to "
+                  "circuit-break and no Health: telemetry will be "
+                  "emitted", file=sys.stderr)
+    deadline_settings = DeadlineSettings.from_config(config.deadline,
+                                                     config.autotune)
+    deadline_stats = (DeadlineStats()
+                      if deadline_settings is not None else None)
+    governors_by_step = {
+        step_idx: HedgeGovernor(step.hedge_ms)
+        for step_idx, step in enumerate(config.steps)
+        if step.replica_queues and step.hedge_ms is not None}
 
     fault_plan = FaultPlan.resolve(config.fault_plan)
     if fault_plan is not None:
         # env-provided plans bypass config parsing — re-check their
         # step indices against this pipeline before launching
         fault_plan.check_steps(config.num_steps)
+        from rnb_tpu.faults import LANE_KINDS
+        if not boards_by_step and any(f["kind"] in LANE_KINDS
+                                      for f in fault_plan.faults):
+            # a lane death without the health layer cannot be
+            # contained: there is no eviction, no drain pump, and no
+            # sibling linger — the queued work would strand and the
+            # run would hang to the barrier timeout. Fail at launch
+            # with the fix, not 30 minutes in.
+            raise ValueError(
+                "the fault plan injects replica_crash/replica_stall "
+                "but the config has no enabled root 'health' key (or "
+                "no replica lanes) — lane deaths need the health "
+                "layer's eviction/drain machinery to stay contained")
     if fault_plan is not None and print_progress:
         print("[rnb-tpu] fault plan active: %s" % fault_plan.describe())
 
@@ -389,7 +464,11 @@ def run_benchmark(config_path: str,
     client_kwargs = dict(overload_policy=config.overload_policy,
                          fault_stats=fault_stats, counter=counter,
                          target_num_videos=num_videos,
-                         popularity=config.popularity)
+                         popularity=config.popularity,
+                         deadline_budget_s=(
+                             deadline_settings.budget_ms / 1000.0
+                             if deadline_settings is not None
+                             else None))
     if mean_interval_ms > 0:
         client_args = (config.video_path_iterator,
                        fabric.get_filename_queue(), mean_interval_ms,
@@ -473,6 +552,24 @@ def run_benchmark(config_path: str,
                                and group.in_queue
                                in step.replica_queues else None),
                     in_queue_idx=group.in_queue,
+                    health_board=(boards_by_step.get(step_idx)
+                                  if step.replica_queues
+                                  and group.in_queue
+                                  in step.replica_queues else None),
+                    out_health_board=boards_by_step.get(step_idx + 1),
+                    sibling_queues=(
+                        {q: fabric.queues[step_idx - 1][q]
+                         for q in step.replica_queues}
+                        if step_idx > 0 and step.replica_queues
+                        and group.in_queue in step.replica_queues
+                        else None),
+                    deadline=deadline_settings,
+                    deadline_stats=deadline_stats,
+                    out_hedges=governors_by_step.get(step_idx + 1),
+                    in_hedges=(governors_by_step.get(step_idx)
+                               if step.replica_queues
+                               and group.in_queue
+                               in step.replica_queues else None),
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -662,6 +759,20 @@ def run_benchmark(config_path: str,
         from rnb_tpu.handoff import aggregate_snapshots as \
             aggregate_handoff
         handoff_stats = aggregate_handoff(handoff_sink)
+    # self-healing accounting (rnb_tpu.health): boards/governors are
+    # shared objects, stable once every thread joined above
+    health_stats = None
+    if boards_by_step:
+        from rnb_tpu.health import aggregate_board_snapshots
+        health_stats = aggregate_board_snapshots(
+            [b.snapshot() for b in boards_by_step.values()])
+    deadline_snap = (deadline_stats.snapshot()
+                     if deadline_stats is not None else None)
+    hedge_stats = None
+    if governors_by_step:
+        from rnb_tpu.health import aggregate_hedge_snapshots
+        hedge_stats = aggregate_hedge_snapshots(
+            [g.snapshot() for g in governors_by_step.values()])
     placement_report = None
     if placement_sink is not None:
         import jax
@@ -780,6 +891,44 @@ def run_benchmark(config_path: str,
             # recommendation over the device budget
             f.write("Placement: %s\n"
                     % json.dumps(placement_report, sort_keys=True))
+        if health_stats is not None:
+            # only health-enabled replica runs carry the lines (logs
+            # stay byte-stable otherwise); --check replays every
+            # lane's path against the legal automaton and holds
+            # routes_after_open to 0
+            f.write("Health: lanes=%d transitions=%d opens=%d "
+                    "evictions=%d probes=%d redispatches=%d "
+                    "routes_after_open=%d\n"
+                    % (health_stats["lanes"],
+                       health_stats["transitions"],
+                       health_stats["opens"],
+                       health_stats["evictions"],
+                       health_stats["probes"],
+                       health_stats["redispatches"],
+                       health_stats["routes_after_open"]))
+            if health_stats["lane_detail"]:
+                f.write("Health lanes: %s\n"
+                        % json.dumps(health_stats["lane_detail"],
+                                     sort_keys=True))
+        if deadline_snap is not None:
+            # only deadline-enabled runs carry the lines; --check
+            # cross-foots the per-site counts against the
+            # deadline-suffixed entries of the Shed sites: ledger
+            f.write("Deadline: budget_ms=%d expired=%d\n"
+                    % (round(deadline_settings.budget_ms),
+                       deadline_snap["expired"]))
+            if deadline_snap["sites"]:
+                f.write("Deadline sites: %s\n"
+                        % json.dumps(deadline_snap["sites"],
+                                     sort_keys=True))
+        if hedge_stats is not None:
+            # only hedge_ms runs carry the line; won + lost == fired
+            # is a --check invariant (every fired hedge resolves
+            # exactly once), and wasted_ms is the honesty counter —
+            # hedge compute is overhead, never throughput
+            f.write("Hedge: fired=%d won=%d lost=%d wasted_ms=%d\n"
+                    % (hedge_stats["fired"], hedge_stats["won"],
+                       hedge_stats["lost"], hedge_stats["wasted_ms"]))
         if compile_stats:
             # per-step jit-entry signatures: warmup vocabulary size +
             # signatures first seen inside the measured window
@@ -869,6 +1018,24 @@ def run_benchmark(config_path: str,
         print("Placement plan (predicted occupancy over %d devices): %s"
               % (placement_report["device_budget"],
                  json.dumps(placement_report["plan"], sort_keys=True)))
+    if health_stats is not None and print_progress:
+        print("Health: %d lane(s), %d transition(s), %d open(s), "
+              "%d eviction(s), %d probe(s), %d redispatch(es)"
+              % (health_stats["lanes"], health_stats["transitions"],
+                 health_stats["opens"], health_stats["evictions"],
+                 health_stats["probes"],
+                 health_stats["redispatches"]))
+    if deadline_snap is not None and print_progress:
+        print("Deadline: budget %d ms, %d expired request(s) shed (%s)"
+              % (round(deadline_settings.budget_ms),
+                 deadline_snap["expired"],
+                 ", ".join("%s=%d" % kv for kv in sorted(
+                     deadline_snap["sites"].items())) or "-"))
+    if hedge_stats is not None and print_progress:
+        print("Hedge: %d fired, %d won by the hedge / %d by the "
+              "original, %d ms of loser service wasted"
+              % (hedge_stats["fired"], hedge_stats["won"],
+                 hedge_stats["lost"], hedge_stats["wasted_ms"]))
     if ragged_stats is not None and print_progress:
         print("Ragged: %d emission(s), %d valid row(s) at pool_rows=%d"
               ", %d pad row(s) eliminated vs the bucketed rule, "
@@ -991,6 +1158,30 @@ def run_benchmark(config_path: str,
         handoff_edge_detail=(dict(handoff_stats["edge_detail"])
                              if handoff_stats else {}),
         placement=placement_report or {},
+        health_lanes=health_stats["lanes"] if health_stats else 0,
+        health_transitions=(health_stats["transitions"]
+                            if health_stats else 0),
+        health_opens=health_stats["opens"] if health_stats else 0,
+        health_evictions=(health_stats["evictions"]
+                          if health_stats else 0),
+        health_probes=health_stats["probes"] if health_stats else 0,
+        health_redispatches=(health_stats["redispatches"]
+                             if health_stats else 0),
+        health_routes_after_open=(health_stats["routes_after_open"]
+                                  if health_stats else 0),
+        health_lane_detail=(dict(health_stats["lane_detail"])
+                            if health_stats else {}),
+        deadline_budget_ms=(int(round(deadline_settings.budget_ms))
+                            if deadline_settings is not None else 0),
+        deadline_expired=(deadline_snap["expired"]
+                          if deadline_snap else 0),
+        deadline_sites=(dict(deadline_snap["sites"])
+                        if deadline_snap else {}),
+        hedges_fired=hedge_stats["fired"] if hedge_stats else 0,
+        hedges_won=hedge_stats["won"] if hedge_stats else 0,
+        hedges_lost=hedge_stats["lost"] if hedge_stats else 0,
+        hedges_wasted_ms=(hedge_stats["wasted_ms"]
+                          if hedge_stats else 0),
     )
 
 
@@ -1084,6 +1275,16 @@ def main(argv=None) -> int:
         print("trace: %s"
               % (json.dumps(cfg.trace, sort_keys=True)
                  if cfg.trace else "none"))
+        hedged = {"step%d" % i: s.hedge_ms
+                  for i, s in enumerate(cfg.steps)
+                  if s.hedge_ms is not None}
+        print("health: %s; deadline: %s; hedging: %s"
+              % (json.dumps(cfg.health, sort_keys=True)
+                 if cfg.health else "none",
+                 json.dumps(cfg.deadline, sort_keys=True)
+                 if cfg.deadline else "none",
+                 json.dumps(hedged, sort_keys=True)
+                 if hedged else "none"))
         print("rnb_tpu is ready to go!")
         return 0
 

@@ -46,7 +46,8 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
             fault_stats: Optional[FaultStats] = None,
             counter: Optional[InferenceCounter] = None,
             target_num_videos: Optional[int] = None,
-            popularity: Optional[dict] = None) -> None:
+            popularity: Optional[dict] = None,
+            deadline_budget_s: Optional[float] = None) -> None:
     try:
         source = load_class(video_path_iterator_path)()
         if popularity is not None:
@@ -90,6 +91,15 @@ def _client(video_path_iterator_path: str, filename_queue: "queue.Queue",
                 video_path = next(iterator)
                 time_card = TimeCard(video_count)
                 time_card.record("enqueue_filename")
+                if deadline_budget_s is not None:
+                    # absolute per-request deadline (rnb_tpu.health,
+                    # root 'deadline' config key): every stage
+                    # boundary downstream sheds the request once this
+                    # wall-clock instant passes, instead of computing
+                    # doomed work
+                    time_card.deadline_s = \
+                        time_card.timings["enqueue_filename"] \
+                        + deadline_budget_s
                 # flow anchor for the request's cross-stage trace
                 # chain + an event-driven arrival-rate counter track
                 # (rnb_tpu.trace; one None test each when tracing off)

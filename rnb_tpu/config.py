@@ -29,7 +29,7 @@ RESERVED_KEYWORDS = [
     "model", "queue_groups", "num_shared_tensors", "num_segments",
     "in_queue", "out_queues", "devices", "gpus", "queue_selector",
     "async_dispatch", "max_retries", "retry_backoff_ms", "autotune",
-    "replicas",
+    "replicas", "hedge_ms",
 ]
 
 #: root-level keys with meaning to the runtime (everything else at the
@@ -37,7 +37,8 @@ RESERVED_KEYWORDS = [
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
-    "trace", "ragged", "handoff", "placement", "_comment",
+    "trace", "ragged", "handoff", "placement", "health", "deadline",
+    "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -58,6 +59,13 @@ HANDOFF_KEYWORDS = ["enabled", "mode"]
 
 #: keys a root 'placement' object may carry (rnb_tpu.placement)
 PLACEMENT_KEYWORDS = ["enabled", "mode", "plan"]
+
+#: keys a root 'health' object may carry (rnb_tpu.health)
+HEALTH_KEYWORDS = ["enabled", "suspect_after_ms", "open_after_ms",
+                   "probe_interval_ms"]
+
+#: keys a root 'deadline' object may carry (rnb_tpu.health)
+DEADLINE_KEYWORDS = ["enabled", "budget_ms"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -133,6 +141,11 @@ class StepConfig:
     #: rnb_tpu.handoff.InflightDepths over these so the upstream
     #: ReplicaSelector routes least-loaded (rnb_tpu.selector).
     replica_queues: Optional[tuple] = None
+    #: hedged re-dispatch threshold for dispatches INTO this
+    #: replica-expanded step (rnb_tpu.health.HedgeGovernor): a
+    #: positive millisecond count, or "p95x" for the governor's own
+    #: settle-latency p95 estimate. None = no hedging.
+    hedge_ms: Optional[object] = None
 
     @property
     def effective_shared_tensors(self) -> int:
@@ -192,6 +205,21 @@ class PipelineConfig:
     #: additionally expands the named steps' replica counts at parse
     #: time exactly like a hand-written ``replicas`` key
     placement: Optional[Dict[str, Any]] = None
+    #: validated lane-health / circuit-breaker spec ({"enabled": ..,
+    #: "suspect_after_ms": .., "open_after_ms": ..,
+    #: "probe_interval_ms": ..}), or None; when set the launcher
+    #: builds one rnb_tpu.health.LaneHealthBoard per replica-expanded
+    #: step — the upstream ReplicaSelector stops routing to open
+    #: lanes, evicted lanes drain onto siblings, and log-meta gains
+    #: the Health:/Health lanes: lines
+    health: Optional[Dict[str, Any]] = None
+    #: validated deadline-propagation spec ({"enabled": ..,
+    #: "budget_ms": ..}), or None; when set the client stamps every
+    #: request with an absolute deadline (budget seeded from
+    #: autotune.slo_ms when unset) and every stage boundary sheds
+    #: expired requests (shed reason deadline_expired) instead of
+    #: computing doomed work — rnb_tpu.health
+    deadline: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -546,6 +574,50 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                         "'placement.plan.%s' must be a positive integer "
                         "replica count, got %r" % (key, val))
 
+    health = raw.get("health")
+    if health is not None:
+        _expect(isinstance(health, dict), "'health' must be an object")
+        unknown_h = sorted(set(health) - set(HEALTH_KEYWORDS))
+        _expect(not unknown_h,
+                "'health' has unknown key(s) %s — keys are %s"
+                % (unknown_h, HEALTH_KEYWORDS))
+        _expect(isinstance(health.get("enabled", True), bool),
+                "'health.enabled' must be a boolean")
+        for key in ("suspect_after_ms", "open_after_ms",
+                    "probe_interval_ms"):
+            val = health.get(key)
+            _expect(val is None
+                    or (isinstance(val, (int, float))
+                        and not isinstance(val, bool) and val > 0),
+                    "'health.%s' must be a positive number, got %r"
+                    % (key, val))
+        if health.get("enabled", True):
+            # the same defaulting the runtime applies — a config whose
+            # thresholds invert must fail at parse time, not at launch
+            try:
+                from rnb_tpu.health import HealthSettings
+                HealthSettings.from_config(health)
+            except ValueError as e:
+                raise ConfigError("invalid 'health': %s" % e) from e
+
+    deadline = raw.get("deadline")
+    if deadline is not None:
+        _expect(isinstance(deadline, dict),
+                "'deadline' must be an object")
+        unknown_d = sorted(set(deadline) - set(DEADLINE_KEYWORDS))
+        _expect(not unknown_d,
+                "'deadline' has unknown key(s) %s — keys are %s"
+                % (unknown_d, DEADLINE_KEYWORDS))
+        _expect(isinstance(deadline.get("enabled", True), bool),
+                "'deadline.enabled' must be a boolean")
+        budget = deadline.get("budget_ms")
+        _expect(budget is None
+                or (isinstance(budget, (int, float))
+                    and not isinstance(budget, bool) and budget > 0),
+                "'deadline.budget_ms' must be a positive number "
+                "(defaults to autotune.slo_ms when autotune is "
+                "configured), got %r" % (budget,))
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -716,6 +788,19 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 "%s: 'autotune' must be a boolean (false opts the step "
                 "out of the root autotune controller)" % where)
 
+        hedge_ms = step_raw.get("hedge_ms")
+        if hedge_ms is not None:
+            _expect(hedge_ms == "p95x"
+                    or (isinstance(hedge_ms, (int, float))
+                        and not isinstance(hedge_ms, bool)
+                        and hedge_ms > 0),
+                    "%s: 'hedge_ms' must be a positive millisecond "
+                    "count or \"p95x\", got %r" % (where, hedge_ms))
+            _expect(replica_queues.get(step_idx) is not None,
+                    "%s: 'hedge_ms' needs replica lanes to re-dispatch "
+                    "onto — declare 'replicas' >= 2 on this step"
+                    % where)
+
         step_extras = {k: v for k, v in step_raw.items()
                        if k not in RESERVED_KEYWORDS}
         steps.append(StepConfig(model=step_raw["model"], groups=groups,
@@ -727,7 +812,8 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                                 retry_backoff_ms=float(retry_backoff_ms),
                                 autotune=step_autotune,
                                 replica_queues=replica_queues.get(
-                                    step_idx)))
+                                    step_idx),
+                                hedge_ms=hedge_ms))
 
     return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
                           steps=steps, raw=raw,
@@ -739,4 +825,6 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           ragged=ragged,
                           handoff=handoff,
                           placement=placement,
+                          health=health,
+                          deadline=deadline,
                           trace=trace)

@@ -72,6 +72,28 @@ class InjectedPermanentError(PermanentError):
     """Raised by a :class:`FaultPlan` 'permanent' fault."""
 
 
+class LaneDeathError(Exception):
+    """A replica lane's executor is dead (chaos 'replica_crash' /
+    'replica_stall' fault kinds).
+
+    NOT part of the transient/permanent taxonomy: the *lane* fails, not
+    the request. The executor (rnb_tpu.runner) intercepts it before
+    classification on replica lanes — dead-letters the in-service
+    dispatch, evicts the lane on the health board
+    (rnb_tpu.health.LaneHealthBoard), and re-enqueues the lane's
+    queued-but-undispatched work onto healthy siblings. Escaping to
+    :func:`classify_error` (a plan targeting a non-replica step with no
+    lane to evict) it classifies FATAL, so a misconfigured chaos plan
+    aborts loudly instead of silently containing a lane-scale failure
+    as one dead-lettered request.
+    """
+
+    def __init__(self, message: str, fate: str):
+        super().__init__(message)
+        #: "crash" (immediate death) or "stall" (wedged, then dead)
+        self.fate = fate
+
+
 #: OSErrors that are deterministic verdicts on the input, not blips —
 #: retrying an open() of a file that is not there cannot succeed, so
 #: burning the retry budget on them would only delay the dead-letter
@@ -103,6 +125,8 @@ def fault_reason(exc: BaseException) -> str:
     reason = getattr(exc, "fault_reason", None)
     if reason:
         return str(reason)
+    if isinstance(exc, LaneDeathError):
+        return "replica-%s" % exc.fate
     if isinstance(exc, InjectedTransientError):
         return "injected-transient"
     if isinstance(exc, InjectedPermanentError):
@@ -118,7 +142,13 @@ def fault_reason(exc: BaseException) -> str:
     return type(exc).__name__.lower()
 
 
-VALID_KINDS = ("transient", "permanent", "latency", "stall")
+VALID_KINDS = ("transient", "permanent", "latency", "stall",
+               "replica_crash", "replica_stall")
+
+#: kinds that kill a replica LANE rather than fail a request — they
+#: carry an optional 'lane' (queue index) address and fire exactly once
+#: per matching (step, lane) executor
+LANE_KINDS = ("replica_crash", "replica_stall")
 
 
 def validate_plan(spec: Any) -> Dict[str, Any]:
@@ -159,29 +189,49 @@ def validate_plan(spec: Any) -> Dict[str, Any]:
         if prob is not None and not (isinstance(prob, (int, float))
                                      and 0.0 <= prob <= 1.0):
             raise ValueError("%s: 'probability' must be in [0, 1]" % where)
-        if kind in ("latency", "stall"):
+        if kind in ("latency", "stall", "replica_stall"):
             ms = f.get("ms")
             if not (isinstance(ms, (int, float)) and ms >= 0):
                 raise ValueError("%s: %r faults need a non-negative 'ms'"
                                  % (where, kind))
             if "times" in f:
                 # would be silently ignored (delay kinds fire on
-                # attempt 0 only) — reject like any other typo
+                # attempt 0 only; lane deaths are permanent by nature)
+                # — reject like any other typo
+                raise ValueError("%s: 'times' only applies to "
+                                 "transient/permanent faults" % where)
+        elif kind == "replica_crash":
+            if "ms" in f:
+                raise ValueError("%s: 'ms' only applies to latency/"
+                                 "stall/replica_stall faults" % where)
+            if "times" in f:
                 raise ValueError("%s: 'times' only applies to "
                                  "transient/permanent faults" % where)
         else:
             if "ms" in f:
-                raise ValueError("%s: 'ms' only applies to "
-                                 "latency/stall faults" % where)
+                raise ValueError("%s: 'ms' only applies to latency/"
+                                 "stall/replica_stall faults" % where)
             times = f.get("times", 1)
             if not (isinstance(times, int) and times >= 1):
                 raise ValueError("%s: 'times' must be a positive integer"
                                  % where)
+        lane = f.get("lane")
+        if lane is not None:
+            # any kind may be lane-addressed: replica_crash/
+            # replica_stall target the lane itself; a lane-addressed
+            # 'latency'/'stall' is the SLOW-LANE class (one replica
+            # degrades while its siblings stay fast — the shape
+            # hedged re-dispatch exists for); error kinds emulate a
+            # lane-local fault domain
+            if not (isinstance(lane, int) and not isinstance(lane, bool)
+                    and lane >= 0):
+                raise ValueError("%s: 'lane' must be a non-negative "
+                                 "queue index" % where)
         reason = f.get("reason")
         if reason is not None and not isinstance(reason, str):
             raise ValueError("%s: 'reason' must be a string" % where)
         unknown = set(f) - {"kind", "step", "request_ids", "probability",
-                            "ms", "times", "reason"}
+                            "ms", "times", "reason", "lane"}
         if unknown:
             raise ValueError("%s has unknown keys %s"
                              % (where, sorted(unknown)))
@@ -275,6 +325,15 @@ class FaultPlan:
         return ((request_ids,) if isinstance(request_ids, int)
                 else tuple(request_ids))
 
+    @staticmethod
+    def _lane_matches(fault: Dict[str, Any],
+                      lane: Optional[int]) -> bool:
+        """Lane-addressed faults fire only on the named replica lane
+        (the executor passes its input-queue index); un-addressed
+        faults fire anywhere."""
+        fault_lane = fault.get("lane")
+        return fault_lane is None or fault_lane == lane
+
     def _matches(self, fault_idx: int, fault: Dict[str, Any],
                  step_idx: int, request_ids: tuple) -> Optional[int]:
         """The first matching request id of the batch, or None."""
@@ -291,26 +350,57 @@ class FaultPlan:
                 return rid
         return None
 
-    def stall_ms(self, step_idx: int, request_ids) -> float:
+    def stall_ms(self, step_idx: int, request_ids,
+                 lane: Optional[int] = None) -> float:
         """Total 'stall' milliseconds scheduled at this site (one id or
         a fused batch's id list — each fault contributes at most once
-        per dispatch)."""
+        per dispatch). A lane-addressed stall wedges only the named
+        replica lane's dispatches (the slow-lane chaos class)."""
         request_ids = self._as_ids(request_ids)
         total = 0.0
         for idx, f in enumerate(self.faults):
-            if f["kind"] == "stall" and self._matches(
-                    idx, f, step_idx, request_ids) is not None:
+            if f["kind"] == "stall" and self._lane_matches(f, lane) \
+                    and self._matches(
+                        idx, f, step_idx, request_ids) is not None:
                 total += float(f["ms"])
         return total
 
     def fire(self, step_idx: int, request_ids,
-             attempt: int = 0) -> None:
+             attempt: int = 0, lane: Optional[int] = None) -> None:
         """Sleep scheduled latency, then raise the first matching error
-        fault whose ``times`` budget covers this attempt."""
+        fault whose ``times`` budget covers this attempt.
+
+        ``lane`` is the calling executor's input-queue index on a
+        replica-expanded step (None elsewhere): 'replica_crash' /
+        'replica_stall' faults optionally address one lane with it and
+        raise :class:`LaneDeathError` — a stall first wedges the
+        executor for ``ms`` inside the dispatch (beats stop, the health
+        board's circuit opens from the missing-liveness signal) before
+        the lane is declared dead."""
         request_ids = self._as_ids(request_ids)
         for idx, f in enumerate(self.faults):
             kind = f["kind"]
+            if kind not in LANE_KINDS or attempt > 0:
+                continue
+            if not self._lane_matches(f, lane):
+                continue
+            rid = self._matches(idx, f, step_idx, request_ids)
+            if rid is None:
+                continue
+            fate = "crash" if kind == "replica_crash" else "stall"
+            if kind == "replica_stall":
+                time.sleep(float(f["ms"]) / 1000.0)
+            exc = LaneDeathError(
+                "injected %s at step %d lane %s (request %d)"
+                % (kind, step_idx, lane, rid), fate)
+            reason = f.get("reason")
+            if reason:
+                exc.fault_reason = reason
+            raise exc
+        for idx, f in enumerate(self.faults):
+            kind = f["kind"]
             if kind == "latency" and attempt == 0 \
+                    and self._lane_matches(f, lane) \
                     and self._matches(idx, f, step_idx,
                                       request_ids) is not None:
                 time.sleep(float(f["ms"]) / 1000.0)
@@ -319,6 +409,8 @@ class FaultPlan:
             if kind not in ("transient", "permanent"):
                 continue
             if attempt >= int(f.get("times", 1)):
+                continue
+            if not self._lane_matches(f, lane):
                 continue
             rid = self._matches(idx, f, step_idx, request_ids)
             if rid is None:

@@ -23,6 +23,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -35,7 +36,9 @@ from rnb_tpu.decode import get_decoder
 from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_RGB,
                                    PIX_YUV420, default_decode_threads,
                                    native_available)
-from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
+from rnb_tpu.faults import (FATAL, TRANSIENT, TransientDecodeError,
+                            classify_error, fault_reason)
+from rnb_tpu.health import expired as _deadline_expired
 from rnb_tpu.models.r2p1d import checkpoint as ckpt
 from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
                                           LAYER_INPUT_SHAPES, NUM_LAYERS,
@@ -250,6 +253,12 @@ def _shared_ragged_preprocess(device):
         return fn
 
 
+#: ceiling on one fallback-pool decode's wait: far above any real
+#: decode (tiny y4m/MJPEG clips decode in milliseconds), so hitting it
+#: is a liveness verdict on the worker thread, not a slow file
+FALLBACK_DECODE_TIMEOUT_S = 120.0
+
+
 class _DecodeHandle:
     """In-flight decode work submitted ahead of its turn.
 
@@ -303,7 +312,18 @@ class _DecodeHandle:
                 if first_error is not None:
                     raise first_error
             if self.future is not None:
-                self.future.result()
+                # bounded wait + liveness verdict (the RNB-H009
+                # discipline): a wedged fallback-pool decode thread
+                # dead-letters ONE request as a classified transient
+                # instead of hanging the stage — and, behind it, the
+                # whole replica lane — forever
+                try:
+                    self.future.result(
+                        timeout=FALLBACK_DECODE_TIMEOUT_S)
+                except FuturesTimeout:
+                    raise TransientDecodeError(
+                        "fallback decode of %s unresponsive for %.0fs"
+                        % (video, FALLBACK_DECODE_TIMEOUT_S)) from None
                 self.future = None
         except Exception as e:
             self.error = e
@@ -1229,6 +1249,42 @@ class R2P1DFusingLoader(R2P1DLoader):
         #: by the executor via enable_autotune(); None = the static
         #: fuse/max_hold_ms emission policy exactly as configured
         self.autotune = None
+        #: deadline-expired requests dropped from the ready queue
+        #: before emission (rnb_tpu.health), parked for the
+        #: executor's take_shed() drain — inert without deadlines
+        self._deadline_shed = []
+
+    def take_shed(self):
+        """Executor hook (rnb_tpu.runner): requests this stage shed
+        internally because their deadline expired while the loader
+        held their decoded rows -> [(card, where)]."""
+        out, self._deadline_shed = self._deadline_shed, []
+        return out
+
+    def _drop_expired_ready(self) -> None:
+        """The 'loader hold' deadline boundary (rnb_tpu.health): a
+        decoded request whose absolute deadline passed while it waited
+        on the ready queue is dropped before fusing — its slot rows
+        are released (the emission takes the gapped copy path, exactly
+        like a contained mid-slot decode failure) and it never burns a
+        transfer or downstream service. A record is only dropped when
+        EVERY card riding it (leader + coalesced followers) expired:
+        the rows are shared, and one live follower still needs them.
+        Inert when no card carries a deadline stamp."""
+        if not self._ready or not any(
+                getattr(rec.cards[0], "deadline_s", None) is not None
+                for rec in self._ready):
+            return
+        kept = deque()
+        for rec in self._ready:
+            if all(_deadline_expired(tc) for tc in rec.cards):
+                self._drop_coalesce(rec)
+                self._release_handle_slot(rec.handle)
+                self._deadline_shed.extend((tc, "hold")
+                                           for tc in rec.cards)
+            else:
+                kept.append(rec)
+        self._ready = kept
 
     def enable_autotune(self, settings) -> BatchController:
         """Executor protocol (rnb_tpu.runner): drive this stage's
@@ -1777,6 +1833,7 @@ class R2P1DFusingLoader(R2P1DLoader):
         if out is not None:
             return out
         self._harvest()
+        self._drop_expired_ready()
         if not self._ready:
             return None
         rows_ready = sum(rec.handle.n for rec in self._ready)

@@ -59,7 +59,12 @@ from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
                              TerminationFlag, TerminationState,
                              dispose_requests, send_exit_markers)
 from rnb_tpu.devices import DeviceSpec
-from rnb_tpu.faults import (FATAL, TRANSIENT, classify_error, fault_reason)
+from rnb_tpu.faults import (FATAL, TRANSIENT, LaneDeathError,
+                            classify_error, fault_reason)
+from rnb_tpu.health import (EVICTED, HEALTHY, LOSER, SUSPECT, WINNER,
+                            DirectPayload, deadline_site)
+from rnb_tpu.health import cards_of as health_cards_of
+from rnb_tpu.health import expired as _deadline_expired
 from rnb_tpu.ops.ragged import check_segment_offsets
 from rnb_tpu.placement import CostRecord
 from rnb_tpu.stage import PaddedBatch, RaggedBatch
@@ -209,6 +214,30 @@ class RunnerContext:
     #: the producer's selector routes on
     in_depths: Optional[Any] = None
     in_queue_idx: Optional[int] = None
+    # -- self-healing layer (rnb_tpu.health) --------------------------
+    #: this consumer's replica step's LaneHealthBoard (root 'health'
+    #: config key): the executor publishes a liveness beat per loop
+    #: iteration, settles in-flight age windows, feeds dead-letter
+    #: counts, and — on an injected lane death — evicts its lane
+    health_board: Optional[Any] = None
+    #: the NEXT step's board, handed to this producer's
+    #: ReplicaSelector (bind_health) for circuit-gated routing
+    out_health_board: Optional[Any] = None
+    #: every lane queue of this consumer's replica step (queue idx ->
+    #: Queue): the evicted-lane drain re-enqueues
+    #: queued-but-undispatched work onto healthy siblings through
+    #: these
+    sibling_queues: Optional[Dict[int, "queue.Queue"]] = None
+    #: deadline propagation (root 'deadline' key): settings + the
+    #: job-wide expiry-shed ledger (both None = checks inert)
+    deadline: Optional[Any] = None
+    deadline_stats: Optional[Any] = None
+    #: hedged re-dispatch governors (step key 'hedge_ms' on a
+    #: replica step): out_hedges tracks/fires on the producer side of
+    #: the edge; in_hedges claims exactly-once resolutions on the
+    #: replica step itself
+    out_hedges: Optional[Any] = None
+    in_hedges: Optional[Any] = None
 
 
 def split_segments(payload, num_segments: int):
@@ -299,12 +328,40 @@ def validate_payload(declared, payload, where: str) -> None:
                 raise ValueError("%s output %d: %s" % (where, idx, e))
 
 
-def _cards_of(time_card) -> list:
-    """The individual TimeCards behind one pipeline item (a fused batch
-    carries several)."""
-    if isinstance(time_card, TimeCardList):
-        return list(time_card.time_cards)
-    return [time_card]
+# the ONE fused-card unwrap rule, shared with the hedge governor's
+# claim/key identity (rnb_tpu.health.cards_of) — two copies could
+# silently diverge on what "the cards behind one item" means
+_cards_of = health_cards_of
+
+
+def _hedge_lost(ctx: RunnerContext, time_card) -> bool:
+    """Exactly-once resolution at a hedged replica step: the FIRST
+    disposal/completion event of a hedged dispatch claims the request
+    id(s); the second copy's event is the loser — its result (or
+    failure) is discarded with its burned service time counted as
+    hedge waste, and the caller must drop the item without touching
+    the counters (the rid already terminated through the winner).
+
+    One COPY claims at most once: a copy that already claimed WINNER
+    (marked ``hedge_resolved`` on its cards) owns the rid's terminal
+    outcome — a later disposal of the same copy in the same iteration
+    (e.g. its deadline expired between completion and publish)
+    proceeds normally instead of consuming the sibling's LOSER slot,
+    which would let the real sibling copy claim UNTRACKED and publish
+    the rid a second time."""
+    if ctx.in_hedges is None:
+        return False
+    cards = _cards_of(time_card)
+    if any(getattr(tc, "hedge_resolved", False) for tc in cards):
+        return False
+    verdict = ctx.in_hedges.claim(time_card)
+    if verdict == LOSER:
+        ctx.in_hedges.discard(time_card)
+        return True
+    if verdict == WINNER:
+        for tc in cards:
+            tc.hedge_resolved = True
+    return False
 
 
 def _contain_failure(ctx: RunnerContext, time_card, reason: str,
@@ -313,6 +370,8 @@ def _contain_failure(ctx: RunnerContext, time_card, reason: str,
     record job-wide accounting, and count the disposal toward the run
     target so the job still terminates (a failed request will never
     produce the completion the target otherwise waits for)."""
+    if _hedge_lost(ctx, time_card):
+        return
     cards = _cards_of(time_card)
     for tc in cards:
         if tc.status == "ok":
@@ -320,16 +379,27 @@ def _contain_failure(ctx: RunnerContext, time_card, reason: str,
     if ctx.fault_stats is not None:
         ctx.fault_stats.record_failure([tc.id for tc in cards],
                                        ctx.step_idx, reason)
+    if ctx.health_board is not None:
+        # the lane's dead-letter signal (one of the three circuit
+        # inputs next to in-flight age and the liveness beat)
+        ctx.health_board.note_failure(ctx.in_queue_idx)
     if summary is not None:
         summary.note_failure(reason, len(cards))
     dispose_requests(ctx.counter, ctx.num_videos, ctx.termination,
                      len(cards))
 
 
-def _shed_item(ctx: RunnerContext, time_card, summary) -> None:
+def _shed_item(ctx: RunnerContext, time_card, summary,
+               lane: Optional[int] = None) -> None:
     """Drop one item under ``overload_policy: "shed"`` (downstream
-    queue full): counted, stamped, disposed — never aborts the job."""
-    site = "step%d_out_queue" % ctx.step_idx
+    queue full): counted, stamped, disposed — never aborts the job.
+    ``lane`` names the chosen replica lane queue when the full edge is
+    replica-expanded, so shed-site accounting is per-lane."""
+    if _hedge_lost(ctx, time_card):
+        return
+    site = ("step%d_out_queue.lane%d" % (ctx.step_idx, lane)
+            if lane is not None
+            else "step%d_out_queue" % ctx.step_idx)
     cards = _cards_of(time_card)
     for tc in cards:
         tc.mark_shed(site)
@@ -339,6 +409,195 @@ def _shed_item(ctx: RunnerContext, time_card, summary) -> None:
         summary.note_shed(len(cards))
     dispose_requests(ctx.counter, ctx.num_videos, ctx.termination,
                      len(cards))
+
+
+def _shed_deadline(ctx: RunnerContext, time_card, where: str,
+                   summary) -> None:
+    """Shed an item whose every constituent blew its absolute deadline
+    (rnb_tpu.health, root 'deadline' key): the expiry rides the PR 1
+    shed machinery — counted in FaultStats per site AND in the
+    deadline ledger, which parse_utils --check cross-foots."""
+    if _hedge_lost(ctx, time_card):
+        return
+    site = deadline_site(where)
+    cards = _cards_of(time_card)
+    for tc in cards:
+        tc.mark_shed(site)
+    if ctx.fault_stats is not None:
+        ctx.fault_stats.record_shed(site, len(cards))
+    if ctx.deadline_stats is not None:
+        ctx.deadline_stats.record(site, len(cards))
+    if summary is not None:
+        summary.note_shed(len(cards))
+    dispose_requests(ctx.counter, ctx.num_videos, ctx.termination,
+                     len(cards))
+
+
+def _sheddable_expired(ctx: RunnerContext, time_card) -> bool:
+    """Deadline boundary check: expired AND legal to shed (forked
+    segment cards never shed — dropping one segment would strand its
+    aggregator siblings, same rule as the overload shed path)."""
+    return (ctx.deadline is not None
+            and getattr(time_card, "sub_id", None) is None
+            and _deadline_expired(time_card))
+
+
+def _pick_lane(depths, board, queue_indices,
+               exclude: Optional[int] = None) -> Optional[int]:
+    """Deterministic healthy-sibling choice for hedges and evicted-
+    lane redispatch: healthy/suspect lanes first, non-evicted as the
+    fallback, least-loaded wins with the lowest queue index as the
+    stable tie-break. None when no candidate lane exists."""
+    candidates = [q for q in queue_indices if q != exclude]
+    if board is not None:
+        live = [q for q in candidates
+                if board.state(q) in (HEALTHY, SUSPECT)]
+        if not live:
+            live = [q for q in candidates
+                    if board.state(q) != EVICTED]
+        candidates = live
+    if not candidates:
+        return None
+    if depths is None:
+        return candidates[0]
+    return min(candidates, key=lambda q: (depths.depth(q), q))
+
+
+def _fire_hedges(ctx: RunnerContext) -> None:
+    """Producer-side hedge tick: re-issue every dispatch outstanding
+    past the governor's threshold onto the best healthy sibling lane.
+    The hedge item carries its payload directly (DirectPayload) — the
+    original still owns its ring slot — and a stamp-complete card
+    clone, so whichever copy resolves first produces an identical
+    summary row. A full sibling queue just defers the hedge to a
+    later tick (hedging must never add backpressure)."""
+    gov = ctx.out_hedges
+    if gov is None or ctx.out_queues is None:
+        return
+    for entry in gov.poll():
+        lane = _pick_lane(ctx.out_depths, ctx.out_health_board,
+                          ctx.out_queue_indices, exclude=entry.lane)
+        if lane is None:
+            continue
+        # commit BEFORE the enqueue: begin_fire re-checks under the
+        # governor lock that the dispatch is still unresolved, so a
+        # copy can never be fired for a request that already claimed
+        # (the late copy would win a second time and double-publish)
+        if not gov.begin_fire(entry):
+            continue
+        item = (DirectPayload(entry.payload), entry.non_tensors,
+                entry.card)
+        try:
+            ctx.out_queues[ctx.out_queue_indices.index(lane)] \
+                .put_nowait(item)
+        except queue.Full:
+            gov.cancel_fire(entry)
+            continue
+        if ctx.out_depths is not None:
+            ctx.out_depths.inc(lane)
+        if ctx.out_health_board is not None:
+            ctx.out_health_board.note_enqueue(lane)
+
+
+def _linger_for_hedges(ctx: RunnerContext) -> None:
+    """Producer end-of-stream hook: the stream may end long before a
+    wedged downstream dispatch exceeds its hedge threshold — exiting
+    then would orphan exactly the tail dispatches hedging exists for.
+    Keep ticking the governor until every tracked dispatch settled
+    (consumers settle at their loop top, so this drains naturally) or
+    the job terminates; the caller sends exit markers only after, so
+    a late hedge can never arrive behind an end-of-stream marker."""
+    gov = ctx.out_hedges
+    if gov is None:
+        return
+    while not ctx.termination.terminated and gov.num_outstanding():
+        _fire_hedges(ctx)
+        time.sleep(QUEUE_POLL_S / 5.0)
+
+
+def _die_lane(ctx: RunnerContext, exc: LaneDeathError,
+              summary) -> None:
+    """This replica lane's executor is dead (injected replica_crash /
+    replica_stall): once the lane's LAST instance died, evict the
+    lane so the upstream selector stops feeding it, then run a
+    drain-and-redispatch pump until end-of-stream: every
+    queued-but-undispatched item moves to a healthy sibling lane
+    (``redispatched`` content stamp, in-flight windows reconciled on
+    both lanes), so no request is ever stranded behind a dead lane.
+    No model call happens after the death; the in-service dispatch
+    was already dead-lettered by the caller."""
+    if ctx.health_board is None:
+        # no board: siblings have no end-of-stream linger, so a late
+        # redispatch could land in a queue whose executor already
+        # exited, and instance deaths cannot be coordinated — the
+        # launcher rejects lane-death fault plans without the root
+        # 'health' key, so this is only the defensive backstop
+        return
+    if ctx.health_board.instance_died(ctx.in_queue_idx) > 0:
+        # a live sibling instance still consumes this lane's
+        # queue — the lane serves on at reduced capacity, and
+        # draining it would steal live work, not rescue it. (A
+        # lane-addressed fault will kill that instance too on
+        # its next matching dispatch; the LAST death drains.)
+        return
+    ctx.health_board.evict(ctx.in_queue_idx,
+                           "replica-%s" % exc.fate)
+    if ctx.sibling_queues is None:
+        return
+    targets = {q: sq for q, sq in ctx.sibling_queues.items()
+               if q != ctx.in_queue_idx}
+    if not targets:
+        return
+    tr_redispatch = trace.name("exec%d.redispatch", ctx.step_idx)
+    try:
+        _pump_dead_lane(ctx, targets, tr_redispatch)
+    finally:
+        # the dead lane's stream is over (its queue remainder moved to
+        # siblings): release any sibling lingering on the drained
+        # latch (rnb_tpu.health end-of-stream protocol)
+        if ctx.health_board is not None:
+            ctx.health_board.note_drained(ctx.in_queue_idx)
+
+
+def _pump_dead_lane(ctx: RunnerContext, targets, tr_redispatch) -> None:
+    while not ctx.termination.terminated:
+        try:
+            item = ctx.in_queue.get(timeout=QUEUE_POLL_S)
+        except queue.Empty:
+            continue
+        if item is None:
+            return  # end-of-stream: nothing more can strand here
+        lane = _pick_lane(ctx.in_depths, ctx.health_board,
+                          sorted(targets))
+        if lane is None:
+            lane = sorted(targets)[0]
+        _sig, _nt, tc = item
+        with trace.span(tr_redispatch):
+            for c in _cards_of(tc):
+                c.redispatched = getattr(c, "redispatched", 0) + 1
+            # bounded put + liveness re-check: a dying pipeline must
+            # not wedge the drain pump forever (RNB-H009 discipline)
+            while not ctx.termination.terminated:
+                try:
+                    targets[lane].put(item, timeout=QUEUE_POLL_S)
+                    break
+                except queue.Full:
+                    continue
+            else:
+                return
+        if ctx.in_depths is not None:
+            # reconcile the in-flight windows: the item leaves this
+            # lane's count and joins the target's, so the selector's
+            # depth view (and --check's settlement) still closes
+            ctx.in_depths.dec(ctx.in_queue_idx)
+            ctx.in_depths.inc(lane)
+        if ctx.health_board is not None:
+            ctx.health_board.note_settle(ctx.in_queue_idx)
+            ctx.health_board.note_enqueue(lane)
+            ctx.health_board.note_redispatch(ctx.in_queue_idx)
+        # (a moved dispatch is still the ORIGINAL hedge copy, if one
+        # was fired for it: its claim window keeps running and
+        # resolves wherever it lands)
 
 
 def _drain_stage_failures(ctx: RunnerContext, take_failed, take_retries,
@@ -415,6 +674,17 @@ def runner(ctx: RunnerContext) -> None:
                 # in-flight depth counters so routing is least-loaded
                 selector.bind_depths(ctx.out_depths,
                                      ctx.out_queue_indices)
+                if ctx.out_health_board is not None \
+                        and hasattr(selector, "bind_health"):
+                    # circuit-gated routing (rnb_tpu.health): open/
+                    # evicted lanes leave the candidate set; half-open
+                    # lanes get their single recovery probe
+                    selector.bind_health(ctx.out_health_board)
+        if ctx.health_board is not None:
+            # lane-instance census (pre-barrier, so deaths can never
+            # race registration): the LAST instance to die is the one
+            # that drains the lane
+            ctx.health_board.register_instance(ctx.in_queue_idx)
         if ctx.handoff_settings is not None \
                 and ctx.input_rings is not None:
             # device-resident handoff (rnb_tpu.handoff): this
@@ -471,6 +741,10 @@ def runner(ctx: RunnerContext) -> None:
     # transfer_async on a fusing loader) surface completed emissions
     # through take_ready(); resolve once
     take_ready = getattr(model, "take_ready", None)
+    # stages that hold work internally (loader accumulator, Batcher)
+    # surface deadline-expired requests they shed at admission through
+    # take_shed() -> [(card, where)]; resolve once
+    take_shed = getattr(model, "take_shed", None)
     if model is not None and take_failed is not None and ctx.containment:
         # stages with internal containment retry transients themselves;
         # hand them the step's schema retry knobs (never model kwargs).
@@ -514,10 +788,29 @@ def runner(ctx: RunnerContext) -> None:
         prefetch_depth = int(getattr(model, "prefetch_depth", 0) or 0)
     pending = deque()  # (handle, non_tensors, time_card) submitted
     saw_marker = False
+    # end-of-stream linger (health-enabled replica lanes): this lane
+    # saw its exit marker but siblings may still redispatch stranded
+    # work here — keep polling until the whole step drained
+    marker_noted = False
+    # all_drained was observed True once: one final timed sweep of the
+    # queue runs before exiting (a pump's last put happens-before its
+    # drained note, so one more poll after the observation closes the
+    # Empty-then-put-then-drained ordering race)
+    linger_final_sweep = False
 
     try:
         if model is not None:
             while not ctx.termination.terminated:
+                if ctx.health_board is not None:
+                    # explicit liveness beat: a wedged executor stops
+                    # publishing these while its queue keeps aging —
+                    # the circuit's missing-liveness signal
+                    ctx.health_board.beat(ctx.in_queue_idx)
+                if ctx.out_hedges is not None:
+                    # producer-side hedge tick: re-issue dispatches
+                    # outstanding past the threshold onto healthy
+                    # siblings (rnb_tpu.health)
+                    _fire_hedges(ctx)
                 if depth_owed:
                     # the previous iteration's popped item(s) have
                     # fully processed: close their in-flight window so
@@ -525,12 +818,22 @@ def runner(ctx: RunnerContext) -> None:
                     # against this lane
                     if ctx.in_depths is not None:
                         ctx.in_depths.dec(ctx.in_queue_idx, depth_owed)
+                    if ctx.health_board is not None:
+                        ctx.health_board.note_settle(ctx.in_queue_idx,
+                                                     depth_owed)
                     depth_owed = 0
                 # dead-letter requests the stage contained internally
                 # during the previous iteration (fused-batch members
                 # whose decode failed)
                 _drain_stage_failures(ctx, take_failed, take_retries,
                                       summary)
+                if take_shed is not None:
+                    # requests the stage shed at admission because
+                    # their deadline expired while it held work
+                    for tc_shed, where in take_shed():
+                        _shed_deadline(ctx, tc_shed,
+                                       "step%d_%s" % (ctx.step_idx,
+                                                      where), summary)
                 handle = None
                 # end-of-stream flush: a marker with an accumulating
                 # stage (batcher) still holding a partial batch emits
@@ -573,6 +876,14 @@ def runner(ctx: RunnerContext) -> None:
                         tc.record("runner%d_start" % ctx.step_idx)
                         if ctx.tracer is not None:
                             trace.instant(tr_swallow, rid=tc.id)
+                        if _sheddable_expired(ctx, tc):
+                            # expiry shed before the decode is even
+                            # submitted — the whole point of deadline
+                            # propagation is never decoding doomed work
+                            _shed_deadline(ctx, tc,
+                                           "step%d_take" % ctx.step_idx,
+                                           summary)
+                            continue
                         try:
                             pending.append((model.submit(nt, tc), nt, tc))
                         except Exception as exc:
@@ -615,6 +926,20 @@ def runner(ctx: RunnerContext) -> None:
                                                else tr_queue_get):
                                 item = ctx.in_queue.get(timeout=timeout)
                     except queue.Empty:
+                        if marker_noted \
+                                and ctx.health_board.all_drained():
+                            # lingering past our own end-of-stream and
+                            # every sibling lane has now drained too.
+                            # A pump's final put may have landed
+                            # BETWEEN our Empty and this check (puts
+                            # happen-before the drained note), so run
+                            # exactly one more timed sweep before
+                            # exiting — after all_drained, no NEW put
+                            # can occur, so the second Empty is proof
+                            if linger_final_sweep:
+                                break
+                            linger_final_sweep = True
+                            continue
                         # idle tick: give accumulator stages (fusing
                         # loader) a chance to emit on hold-timeout —
                         # without this, a decoded request would wait
@@ -630,10 +955,34 @@ def runner(ctx: RunnerContext) -> None:
                     if item is _IDLE_EMIT:
                         pass  # flushed already holds the emission
                     elif item is None:
-                        saw_marker = True
-                        flushed = _eos_flush(model)
-                        if flushed is None:
-                            break  # end-of-stream marker
+                        if ctx.health_board is not None:
+                            # end-of-stream LINGER (rnb_tpu.health):
+                            # a lane evicted after this one finished
+                            # redispatches its queue here — exiting
+                            # on our own marker would strand that
+                            # work in a queue nobody reads. Note our
+                            # drain, keep polling, and exit only once
+                            # every sibling lane drained too.
+                            if not marker_noted:
+                                ctx.health_board.note_drained(
+                                    ctx.in_queue_idx)
+                                marker_noted = True
+                            flushed = _eos_flush(model)
+                            if flushed is None:
+                                if ctx.health_board.all_drained():
+                                    # same one-more-sweep rule as the
+                                    # Empty branch: a pump's final put
+                                    # can precede its drained note
+                                    if linger_final_sweep:
+                                        saw_marker = True
+                                        break
+                                    linger_final_sweep = True
+                                continue
+                        else:
+                            saw_marker = True
+                            flushed = _eos_flush(model)
+                            if flushed is None:
+                                break  # end-of-stream marker
                     else:
                         signal, non_tensors, time_card = item
                         if ctx.in_depths is not None:
@@ -659,7 +1008,14 @@ def runner(ctx: RunnerContext) -> None:
                                 if t_enq is not None:
                                     controller.observe_enqueue(t_enq)
 
-                        if signal is not None:
+                        if isinstance(signal, DirectPayload):
+                            # a hedged re-dispatch (rnb_tpu.health):
+                            # the payload rides inside the item — the
+                            # ORIGINAL copy still owns its ring slot,
+                            # so there is no slot to read or release
+                            tensors = signal.payload
+                            signal = None
+                        elif signal is not None:
                             ring = ctx.input_rings[signal.group_idx][
                                 signal.instance_idx]
                             slot = ring.slots[signal.tensor_idx]
@@ -670,17 +1026,28 @@ def runner(ctx: RunnerContext) -> None:
                                 # read — exit (reference runner.py:96-100)
                                 break
                             slot.release()
-                            if handoff is not None and tensors:
-                                # the edge contract (rnb_tpu.handoff):
-                                # adopt/reshard the committed payload
-                                # onto this consumer — and account the
-                                # move, so "zero host-hop bytes" is a
-                                # log fact, not a claim
-                                with hostprof.section(sec_handoff), \
-                                        trace.span(tr_handoff):
-                                    tensors = handoff.take(tensors)
                         else:
                             tensors = None
+                        if _sheddable_expired(ctx, time_card):
+                            # queue-take expiry shed (root 'deadline'
+                            # key): the request's budget is already
+                            # blown — drop it HERE, before decode /
+                            # reshard / model work burns anything on
+                            # it (the ring slot above is released, so
+                            # nothing upstream blocks)
+                            _shed_deadline(ctx, time_card,
+                                           "step%d_take" % ctx.step_idx,
+                                           summary)
+                            continue
+                        if handoff is not None and tensors:
+                            # the edge contract (rnb_tpu.handoff):
+                            # adopt/reshard the committed payload
+                            # onto this consumer — and account the
+                            # move, so "zero host-hop bytes" is a
+                            # log fact, not a claim
+                            with hostprof.section(sec_handoff), \
+                                    trace.span(tr_handoff):
+                                tensors = handoff.take(tensors)
 
                 if flushed is not None:
                     # constituents carry their own runner/inference start
@@ -698,13 +1065,14 @@ def runner(ctx: RunnerContext) -> None:
                         # inference span: the delay surfaces downstream
                         # as queue wait while this stage's input queue
                         # backs up — a reproducible overload window
-                        stall = ctx.fault_plan.stall_ms(ctx.step_idx,
-                                                        rids)
+                        stall = ctx.fault_plan.stall_ms(
+                            ctx.step_idx, rids, lane=ctx.in_queue_idx)
                         if stall > 0:
                             time.sleep(stall / 1000.0)
                     time_card.record("inference%d_start" % ctx.step_idx)
                     attempt = 0
                     failed_reason = None
+                    lane_death = None
                     t_busy0 = (time.monotonic()
                                if ctx.placement_sink is not None
                                else None)
@@ -720,9 +1088,12 @@ def runner(ctx: RunnerContext) -> None:
                                     # stage service, and the trace
                                     # timeline / placement busy
                                     # accounting must agree on what
-                                    # service means
-                                    ctx.fault_plan.fire(ctx.step_idx,
-                                                        rids, attempt)
+                                    # service means; the lane address
+                                    # lets replica_crash/replica_stall
+                                    # faults target ONE lane
+                                    ctx.fault_plan.fire(
+                                        ctx.step_idx, rids, attempt,
+                                        lane=ctx.in_queue_idx)
                                 if handle is not None and attempt == 0:
                                     tensors_out, non_tensors_out, \
                                         time_card = model.complete(
@@ -748,6 +1119,22 @@ def runner(ctx: RunnerContext) -> None:
                                 if hasattr(model, "discard"):
                                     model.discard(handle, non_tensors)
                                 handle = None
+                            if isinstance(exc, LaneDeathError) \
+                                    and ctx.containment \
+                                    and ctx.in_depths is not None:
+                                # lane-scale death (chaos
+                                # replica_crash/replica_stall), not a
+                                # request fault: dead-letter the
+                                # in-service dispatch below, then hand
+                                # the lane to the eviction drain. On
+                                # non-replica steps the error falls
+                                # through to classify_error -> FATAL
+                                # (a chaos plan aimed at a lane-less
+                                # step is a config bug, not a
+                                # containable fault).
+                                lane_death = exc
+                                failed_reason = fault_reason(exc)
+                                break
                             kind = classify_error(exc)
                             if kind is FATAL or not ctx.containment:
                                 raise  # job-fatal, exactly as before
@@ -796,6 +1183,13 @@ def runner(ctx: RunnerContext) -> None:
                         # and keep the stream flowing
                         _contain_failure(ctx, in_card, failed_reason,
                                          summary)
+                        if lane_death is not None:
+                            # this lane is dead: evict it, drain its
+                            # queued work onto healthy siblings, then
+                            # exit the hot loop for good (no model
+                            # call ever runs here again)
+                            _die_lane(ctx, lane_death, summary)
+                            break
                         continue
                     if time_card is None:
                         # stage swallowed the item (accumulating batcher
@@ -816,6 +1210,13 @@ def runner(ctx: RunnerContext) -> None:
                 time_card.record("inference%d_finish" % ctx.step_idx)
                 if ctx.placement_sink is not None:
                     stage_dispatches += 1
+                if ctx.in_hedges is not None \
+                        and _hedge_lost(ctx, time_card):
+                    # first completion wins: a sibling copy already
+                    # resolved this hedged dispatch — discard this
+                    # result (service time lands in hedges_wasted_ms,
+                    # nothing publishes, nothing double-counts)
+                    continue
                 if controller is not None and tensors_out \
                         and flushed is None \
                         and not getattr(model, "AUTOTUNE_SELF_SERVICE",
@@ -855,6 +1256,14 @@ def runner(ctx: RunnerContext) -> None:
 
                 out_queue = None
                 if ctx.out_queues is not None:
+                    if _sheddable_expired(ctx, time_card):
+                        # pre-ring-write expiry shed: the computed
+                        # output is already too late — drop it before
+                        # it occupies a ring slot or downstream queue
+                        _shed_deadline(ctx, time_card,
+                                       "step%d_publish" % ctx.step_idx,
+                                       summary)
+                        continue
                     # route BEFORE the ring publish so a shed decision
                     # can drop the item while no ring slot holds it (a
                     # written-but-never-signalled slot would deadlock
@@ -873,7 +1282,13 @@ def runner(ctx: RunnerContext) -> None:
                             and getattr(time_card, "sub_id", None) is None
                             and out_queue.qsize() + ctx.num_segments
                             > out_queue.maxsize):
-                        _shed_item(ctx, time_card, summary)
+                        # on a replica-expanded edge the shed site is
+                        # per-LANE: which lane's queue filled up is
+                        # the signal (satellite of the health layer)
+                        _shed_item(ctx, time_card, summary,
+                                   lane=(ctx.out_queue_indices[out_idx]
+                                         if ctx.out_depths is not None
+                                         else None))
                         continue
 
                 if ctx.output_ring is not None:
@@ -935,6 +1350,17 @@ def runner(ctx: RunnerContext) -> None:
                                 else:
                                     sig = None
                                 item = (sig, non_tensors_out, forked)
+                                if ctx.out_hedges is not None:
+                                    # snapshot the hedge template
+                                    # BEFORE the put: the card clone
+                                    # must never race the consumer's
+                                    # stamps, and the payload refs
+                                    # (immutable arrays) outlive the
+                                    # ring slot's reuse
+                                    ctx.out_hedges.track(
+                                        forked,
+                                        ctx.out_queue_indices[out_idx],
+                                        tensors_out, non_tensors_out)
                                 enqueued = False
                                 if ctx.overload_policy == "shed":
                                     # capacity raced away since the
@@ -960,6 +1386,11 @@ def runner(ctx: RunnerContext) -> None:
                                     # on its chosen replica lane
                                     ctx.out_depths.inc(
                                         ctx.out_queue_indices[out_idx])
+                                    if ctx.out_health_board is not None:
+                                        ctx.out_health_board \
+                                            .note_enqueue(
+                                                ctx.out_queue_indices[
+                                                    out_idx])
                     except queue.Full:
                         # counted telemetry, not a stray stdout line:
                         # the per-edge overflow count lands in
@@ -977,10 +1408,15 @@ def runner(ctx: RunnerContext) -> None:
                 # hold more (fusing loaders flush one batch per call);
                 # the loop re-enters the drain branch until flush()
                 # returns None
-            # the final flush may have contained failures after the
-            # last loop-top drain ran
+            # the final flush may have contained failures (or parked
+            # deadline sheds) after the last loop-top drain ran
             _drain_stage_failures(ctx, take_failed, take_retries,
                                   summary)
+            if take_shed is not None:
+                for tc_shed, where in take_shed():
+                    _shed_deadline(ctx, tc_shed,
+                                   "step%d_%s" % (ctx.step_idx, where),
+                                   summary)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
@@ -997,6 +1433,14 @@ def runner(ctx: RunnerContext) -> None:
         if model is not None and hasattr(model, "discard_pending"):
             try:
                 model.discard_pending()
+            except Exception:
+                traceback.print_exc()
+        # hedged edges: keep the governor ticking until every
+        # outstanding downstream dispatch settled — hedges fired after
+        # this producer's exit markers would strand behind them
+        if ctx.out_hedges is not None:
+            try:
+                _linger_for_hedges(ctx)
             except Exception:
                 traceback.print_exc()
         # drain: the LAST producer on each edge marks end-of-stream, so
@@ -1081,9 +1525,16 @@ def runner(ctx: RunnerContext) -> None:
             except Exception:
                 traceback.print_exc()
         # replica-lane settlement for an item still in service when
-        # the loop exited (abort / target-reached break)
-        if depth_owed and ctx.in_depths is not None:
-            ctx.in_depths.dec(ctx.in_queue_idx, depth_owed)
+        # the loop exited (abort / target-reached break); the hedge
+        # governor needs no twin here — claim() settles on every
+        # resolution path, and unresolved abort-path dispatches are
+        # released by the producer's termination-gated linger
+        if depth_owed:
+            if ctx.in_depths is not None:
+                ctx.in_depths.dec(ctx.in_queue_idx, depth_owed)
+            if ctx.health_board is not None:
+                ctx.health_board.note_settle(ctx.in_queue_idx,
+                                             depth_owed)
             depth_owed = 0
         # device-resident handoff accounting (rnb_tpu.handoff): the
         # stage is drained, counters are stable

@@ -60,6 +60,16 @@ class ReplicaSelector(QueueSelector):
     is a pure function of the depth sequence. Without bound depths
     (hand-written configs naming this selector on a non-replica edge)
     it degrades to round-robin.
+
+    With a bound :class:`rnb_tpu.health.LaneHealthBoard`
+    (``bind_health``, root ``health`` config key), routing is
+    additionally health-gated: open/evicted lanes leave the candidate
+    set, a half-open lane due for its recovery probe receives exactly
+    that one dispatch, and the lowest-lane tie-break skips excluded
+    lanes **stably** — the surviving lanes keep their original
+    relative order, so a seeded run replays the identical routing
+    sequence across chaos arms whatever subset of lanes is alive
+    (the regression test pins this for a seeded kill schedule).
     """
 
     def __init__(self, num_queues: int):
@@ -67,6 +77,10 @@ class ReplicaSelector(QueueSelector):
         self._rr = 0
         self._depths = None          # rnb_tpu.handoff.InflightDepths
         self._queue_indices = None   # lane position -> queue index
+        self._health = None          # rnb_tpu.health.LaneHealthBoard
+        #: True when the last select() was a forced route (no healthy
+        #: sibling existed) — the executor reads it for accounting
+        self.last_route_forced = False
 
     def bind_depths(self, depths, queue_indices) -> None:
         """Executor protocol (rnb_tpu.runner): share the replica
@@ -80,14 +94,44 @@ class ReplicaSelector(QueueSelector):
         self._depths = depths
         self._queue_indices = [int(q) for q in queue_indices]
 
+    def bind_health(self, board) -> None:
+        """Executor protocol: share the replica step's lane health
+        board (rnb_tpu.health) so routing stops feeding open/evicted
+        lanes and carries half-open recovery probes."""
+        self._health = board
+
     def select(self, tensors, non_tensors, time_card) -> int:
+        self.last_route_forced = False
         if self._depths is None:
             choice = self._rr
             self._rr = (self._rr + 1) % self.num_queues
             return choice
-        best, best_depth = 0, None
-        for pos, q_idx in enumerate(self._queue_indices):
+        candidates = self._queue_indices
+        if self._health is not None:
+            allowed, probe = self._health.route_filter(
+                self._queue_indices)
+            if probe is not None:
+                # the single half-open recovery dispatch goes to the
+                # probing lane, bypassing least-loaded entirely
+                self._health.note_route(probe)
+                return self._queue_indices.index(probe)
+            if allowed:
+                # STABLE exclusion: surviving lanes keep their
+                # original relative order, so the deterministic
+                # lowest-lane tie-break replays identically whatever
+                # subset is alive (route_filter preserves the order
+                # of the indices it was given)
+                candidates = allowed
+            if not allowed:
+                # every lane open/evicted: route least-loaded over
+                # whatever exists — deterministic, counted as forced
+                self.last_route_forced = True
+        best_q, best_depth = candidates[0], None
+        for q_idx in candidates:
             depth = self._depths.depth(q_idx)
             if best_depth is None or depth < best_depth:
-                best, best_depth = pos, depth
-        return best
+                best_q, best_depth = q_idx, depth
+        if self._health is not None:
+            self._health.note_route(best_q,
+                                    forced=self.last_route_forced)
+        return self._queue_indices.index(best_q)

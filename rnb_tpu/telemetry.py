@@ -70,7 +70,26 @@ CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced",
                   # sums stay exact; 0 on every other card and on every
                   # ragged emission — the ragged kernel computes no pad
                   # rows)
-                  "pad_rows")
+                  "pad_rows",
+                  # absolute wall-clock deadline (rnb_tpu.health,
+                  # root 'deadline' config key): stamped by the client
+                  # at enqueue; every stage boundary sheds the request
+                  # once it passes — absent on deadline-off runs
+                  "deadline_s",
+                  # times this request was drained off an evicted
+                  # replica lane and re-enqueued onto a healthy
+                  # sibling (rnb_tpu.health lane eviction)
+                  "redispatched",
+                  # True on the CLONE card of a hedged re-dispatch
+                  # (rnb_tpu.health.HedgeGovernor) — the claim site
+                  # reads it to attribute the win to the hedge or the
+                  # original copy
+                  "hedge_copy",
+                  # set once a copy claimed WINNER: later disposal of
+                  # the SAME copy must not claim again (it owns the
+                  # rid's terminal outcome; a re-claim would consume
+                  # the sibling copy's LOSER slot)
+                  "hedge_resolved")
 
 
 # -- the declared telemetry schema ------------------------------------
@@ -174,6 +193,31 @@ META_LINE_REGISTRY = (
               "costs, predicted occupancy, recommended replica plan "
               "(placement-enabled runs only; --check holds the "
               "prediction to the traced busy fraction)"),
+    StampSpec("Health:", "rnb_tpu/benchmark.py",
+              "lane health/circuit-breaker counters: lanes, state "
+              "transitions, circuit opens, evictions, half-open "
+              "probes, redispatched items, routes to open lanes "
+              "(health-enabled replica runs only; --check holds "
+              "routes_after_open to 0 and replays every lane's "
+              "transition path against the legal automaton)"),
+    StampSpec("Health lanes:", "rnb_tpu/benchmark.py",
+              "JSON per-lane health detail: final state, transition "
+              "path, redispatched-from count "
+              "(health-enabled replica runs only)"),
+    StampSpec("Deadline:", "rnb_tpu/benchmark.py",
+              "deadline-propagation counters: configured budget_ms "
+              "and requests shed as deadline_expired "
+              "(deadline-enabled runs only; per-site sheds must sum "
+              "to the total)"),
+    StampSpec("Deadline sites:", "rnb_tpu/benchmark.py",
+              "JSON per-check-site deadline_expired shed counts "
+              "(deadline-enabled runs only)"),
+    StampSpec("Hedge:", "rnb_tpu/benchmark.py",
+              "hedged re-dispatch counters: hedges fired, won by the "
+              "hedge copy, lost (original resolved first), and the "
+              "losers' wasted service milliseconds (hedge_ms runs "
+              "only; won + lost == fired always — hedge compute is "
+              "overhead, never throughput)"),
     StampSpec("Trace:", "rnb_tpu/benchmark.py",
               "trace-export counters: events written to trace.json, "
               "events dropped at the max_events cap "
@@ -234,6 +278,14 @@ TRACE_EVENT_REGISTRY = (
               "span: the edge contract's payload take — adopt or "
               "reshard the committed upstream arrays onto this "
               "consumer (handoff-enabled runs only)"),
+    StampSpec("exec{step}.redispatch", "rnb_tpu/runner.py",
+              "span: an evicted replica lane's executor re-enqueues "
+              "one queued-but-undispatched item onto a healthy "
+              "sibling lane (health-enabled chaos runs only)"),
+    StampSpec("health.lane_state", "rnb_tpu/health.py",
+              "instant: a replica lane's health state transition "
+              "(args: lane, from, to, why) — the timeline face of "
+              "the Health lanes: path log"),
     StampSpec("loader.decode_submit", "rnb_tpu/models/r2p1d/model.py",
               "instant: one request's decode submitted to the pool"),
     StampSpec("loader.decode", "rnb_tpu/models/r2p1d/model.py",

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""``make chaos``: seeded replica-loss containment, asserted end-to-end.
+
+Runs the SHIPPED chaos arm (configs/rnb-scaleout-r4-chaos.json — the
+4-replica scale-out topology with lane health, p95x hedging, and a
+seeded ``replica_stall`` that WEDGES lane 3 on its first dispatch
+mid-stream for 2.5 s — long enough for the router to queue work
+behind it and for the missing-liveness signal to open the circuit —
+before the lane dies for good) through ``run_benchmark`` on the
+8-virtual-device CPU backend, then asserts the self-healing contract:
+
+* the run terminates cleanly at its target — a dead lane must never
+  hang or abort the job;
+* **every request terminates exactly once**: completed + dead-lettered
+  + shed == the request count, with the one in-service dispatch the
+  crash killed dead-lettered under its injected reason — zero
+  stranded work, zero double counts (the chaos arm fuses 1 request
+  per dispatch, so the equality is exact);
+* the dead lane was **evicted** — its transition log is a legal
+  automaton walk ending ``evicted`` — and its queued-but-undispatched
+  work was **redispatched** onto healthy siblings (``redispatched``
+  stamps reconciled into the same exactly-once count);
+* the selector **never routed to the dead lane after the circuit
+  opened**: ``health_routes_after_open == 0``;
+* every fired hedge resolved exactly once (winners + losers == fired);
+* ``parse_utils --check`` is green — including the new
+  Health:/Deadline:/Hedge: invariants and the no-stranding count —
+  with the exit-code discipline intact (0, not 1/2).
+
+Exit 0 = containment holds. ~30 s with a warm XLA compile cache; no
+dataset, no native decoder required (synthetic video ids).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CONFIG = "configs/rnb-scaleout-r4-chaos.json"
+NUM_VIDEOS = 12
+DEAD_LANE = "3"  # the lane queue index the shipped fault plan kills
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rnb_tpu.benchmark import run_benchmark
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="rnb-chaos-") as tmp:
+        res = run_benchmark(os.path.join(REPO, CONFIG),
+                            mean_interval_ms=0, num_videos=NUM_VIDEOS,
+                            queue_size=64, log_base=tmp,
+                            print_progress=False, seed=17)
+        if res.termination_flag != 0:
+            failures.append("chaos run terminated with flag %d"
+                            % res.termination_flag)
+        problems, parse_failed = parse_utils.check_job_detail(
+            res.log_dir)
+        for problem in problems:
+            failures.append("--check (%s): %s"
+                            % ("parse" if parse_failed else "invariant",
+                               problem))
+
+        print("chaos arm: %d completed / %d dead-lettered / %d shed "
+              "of %d requests; %d eviction(s), %d redispatch(es), "
+              "%d probe(s); hedges %d fired = %d won + %d lost "
+              "(%d ms wasted)"
+              % (res.num_completed, res.num_failed, res.num_shed,
+                 NUM_VIDEOS, res.health_evictions,
+                 res.health_redispatches, res.health_probes,
+                 res.hedges_fired, res.hedges_won, res.hedges_lost,
+                 res.hedges_wasted_ms))
+
+        # every request terminates exactly once — the containment
+        # contract's arithmetic face (single-request dispatches make
+        # the equality exact)
+        terminated = res.num_completed + res.num_failed + res.num_shed
+        if terminated != NUM_VIDEOS:
+            failures.append(
+                "%d of %d requests terminated (completed+failed+shed) "
+                "— every request must terminate exactly once"
+                % (terminated, NUM_VIDEOS))
+        # the crash's in-service dispatch dead-letters under the
+        # injected reason; nothing else may fail
+        if res.failure_reasons != {"chaos-lane-kill": res.num_failed} \
+                or res.num_failed < 1:
+            failures.append(
+                "expected >=1 dead letter, all 'chaos-lane-kill'; got "
+                "%s" % json.dumps(res.failure_reasons, sort_keys=True))
+        # the dead lane walked the circuit (the 2.5 s wedge outlives
+        # open_after_ms, so the breaker MUST have opened) and was
+        # evicted exactly once, with a legal path; its queued work
+        # moved to siblings
+        if res.health_evictions != 1:
+            failures.append("expected exactly 1 lane eviction, got %d"
+                            % res.health_evictions)
+        if res.health_opens < 1:
+            failures.append("the circuit never opened during the "
+                            "2.5 s wedge (opens=0)")
+        if res.health_redispatches < 1:
+            failures.append(
+                "no queued work was redispatched off the dead lane — "
+                "the least-loaded router queues behind the wedge, so "
+                "zero moved items means the drain did not run")
+        dead = res.health_lane_detail.get(DEAD_LANE, {})
+        if dead.get("state") != "evicted":
+            failures.append(
+                "lane %s should be evicted, detail says %r"
+                % (DEAD_LANE, dead.get("state")))
+        # siblings kept serving: every surviving lane stayed live
+        for lane, entry in sorted(res.health_lane_detail.items()):
+            if lane != DEAD_LANE and entry.get("state") == "evicted":
+                failures.append("healthy sibling lane %s was evicted"
+                                % lane)
+        # the selector never fed the dead lane after the circuit
+        # opened/evicted while siblings lived
+        if res.health_routes_after_open != 0:
+            failures.append(
+                "selector routed %d dispatch(es) to an open/evicted "
+                "lane" % res.health_routes_after_open)
+        # hedge resolution is exactly-once by construction
+        if res.hedges_won + res.hedges_lost != res.hedges_fired:
+            failures.append(
+                "hedge resolution leak: %d won + %d lost != %d fired"
+                % (res.hedges_won, res.hedges_lost, res.hedges_fired))
+
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("OK — replica-loss chaos contained: lane %s killed "
+          "mid-stream, %d item(s) redispatched, all %d requests "
+          "terminated exactly once, 0 routes after circuit-open, "
+          "--check green" % (DEAD_LANE, res.health_redispatches,
+                             NUM_VIDEOS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

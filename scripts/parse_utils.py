@@ -141,6 +141,39 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["handoff_" + key] = int(val)
+        elif line.startswith("Health lanes:"):
+            # JSON per-lane health detail (state, transition path,
+            # redispatched-from) — must be matched before the
+            # "Health:" prefix below; health-enabled replica runs only
+            import json
+            meta["health_lane_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Health:"):
+            # "Health: lanes=L transitions=T opens=O evictions=E
+            #  probes=P redispatches=R routes_after_open=X" — lane
+            # health/circuit accounting (rnb_tpu.health), written only
+            # by health-enabled replica runs
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["health_" + key] = int(val)
+        elif line.startswith("Deadline sites:"):
+            # JSON per-check-site deadline_expired shed counts — must
+            # be matched before the "Deadline:" prefix below
+            import json
+            meta["deadline_sites"] = json.loads(line.split(":", 1)[1])
+        elif line.startswith("Deadline:"):
+            # "Deadline: budget_ms=B expired=K" — deadline-propagation
+            # accounting (rnb_tpu.health), deadline-enabled runs only
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["deadline_" + key] = int(val)
+        elif line.startswith("Hedge:"):
+            # "Hedge: fired=F won=W lost=L wasted_ms=M" — hedged
+            # re-dispatch accounting (rnb_tpu.health), hedge_ms runs
+            # only; won + lost == fired is a --check invariant
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["hedges_" + key] = int(val)
         elif line.startswith("Placement:"):
             # JSON measured-cost placement report (rnb_tpu.placement):
             # per-step dispatch costs + predicted occupancy + the
@@ -523,11 +556,23 @@ def check_job(job_dir: str) -> List[str]:
     """Cross-artifact consistency check of one job's log directory:
     log-meta vs timing tables vs trailers vs dead letters. Returns a
     list of human-readable problems (empty = consistent)."""
+    return check_job_detail(job_dir)[0]
+
+
+def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
+    """:func:`check_job` plus a parse-failure verdict: ``(problems,
+    parse_failed)`` where ``parse_failed`` marks schema-level
+    unreadability (missing/corrupt log-meta, unparsable timing table)
+    as opposed to an invariant violation over parsable artifacts —
+    the CLI exits 2 for the former and 1 for the latter, matching the
+    rnb-lint convention (2 = the checker could not run, 1 =
+    findings)."""
     problems: List[str] = []
+    parse_failed = False
     try:
         meta = parse_meta(job_dir)
     except (OSError, ValueError) as e:
-        return ["log-meta.txt unreadable: %s" % e]
+        return ["log-meta.txt unreadable: %s" % e], True
     if "termination_flag" not in meta:
         problems.append("log-meta.txt carries no 'Termination flag:'")
     if "wall_time_s" not in meta:
@@ -546,6 +591,7 @@ def check_job(job_dir: str) -> List[str]:
         except (OSError, ValueError) as e:
             problems.append("%s unparsable: %s"
                             % (os.path.basename(path), e))
+            parse_failed = True
             continue
         trailers = parse_table_trailers(path)
         for key in table_faults:
@@ -765,6 +811,14 @@ def check_job(job_dir: str) -> List[str]:
                 "the full shape vocabulary"
                 % (step, int(sigs["steady_new"])))
 
+    # self-healing accounting (rnb_tpu.health): lane transition paths
+    # must be legal automaton walks, routing must never feed an open
+    # lane while siblings lived, deadline sheds must cross-foot
+    # between their two ledgers, and every fired hedge must resolve
+    # exactly once
+    problems.extend(_check_health(meta, num_rows))
+    problems.extend(_check_deadline(meta))
+    problems.extend(_check_hedge(meta))
     # device-resident handoff accounting (rnb_tpu.handoff): every
     # edge take has exactly one class, the per-edge detail must sum
     # to the totals, and a device-resident config must have moved
@@ -784,6 +838,164 @@ def check_job(job_dir: str) -> List[str]:
     # trace.json actually holds, and the artifact must be structurally
     # valid (every event stamped, every flow resolving)
     problems.extend(_check_trace_artifact(job_dir, meta))
+    return problems, parse_failed
+
+
+def _check_health(meta: Dict[str, object],
+                  num_rows: int) -> List[str]:
+    """Lane health/circuit invariants (rnb_tpu.health): the per-lane
+    transition paths must replay as legal automaton walks consistent
+    with the aggregate counters, no route may have landed on an
+    open/evicted lane while a routable sibling existed, and — with
+    the termination target reached — no request may be stranded."""
+    problems: List[str] = []
+    detail = meta.get("health_lane_detail")
+    if "health_lanes" not in meta:
+        if detail is not None:
+            problems.append("log-meta carries a 'Health lanes:' line "
+                            "but no 'Health:' totals line")
+        return problems
+    for key in ("health_lanes", "health_transitions", "health_opens",
+                "health_evictions", "health_probes",
+                "health_redispatches", "health_routes_after_open"):
+        if meta.get(key, 0) < 0:
+            problems.append("negative %s" % key)
+    if meta.get("health_routes_after_open", 0) != 0:
+        problems.append(
+            "health_routes_after_open=%d — the selector routed to an "
+            "open/evicted lane while a routable sibling existed "
+            "(circuit containment violated)"
+            % meta["health_routes_after_open"])
+    if detail is None:
+        if meta.get("health_lanes", 0) != 0:
+            problems.append("'Health:' counts %d lane(s) but no "
+                            "'Health lanes:' detail line exists"
+                            % meta["health_lanes"])
+        return problems
+    _rnb_trace()  # side effect: puts the repo checkout on sys.path
+    from rnb_tpu import health as health_mod
+    detail = {k: dict(v) for k, v in dict(detail).items()}
+    if len(detail) != meta.get("health_lanes", 0):
+        problems.append("'Health lanes:' names %d lane(s) but the "
+                        "'Health:' line says lanes=%d"
+                        % (len(detail), meta.get("health_lanes", 0)))
+    transitions = evictions = opens = redispatches = routes = 0
+    for lane, entry in sorted(detail.items()):
+        path = list(entry.get("path", []))
+        if not health_mod.legal_path(path):
+            problems.append(
+                "lane %s transition path %s is not a legal walk of "
+                "the health automaton (healthy start, declared edges "
+                "only)" % (lane, path))
+        if path and entry.get("state") != path[-1]:
+            problems.append(
+                "lane %s final state %r disagrees with its path %s"
+                % (lane, entry.get("state"), path))
+        transitions += max(0, len(path) - 1)
+        opens += sum(1 for s in path if s == health_mod.OPEN)
+        evictions += sum(1 for s in path if s == health_mod.EVICTED)
+        redispatches += int(entry.get("redispatched_from", 0))
+        routes += int(entry.get("routes_after_open", 0))
+        if int(entry.get("redispatched_from", 0)) \
+                and entry.get("state") != health_mod.EVICTED:
+            problems.append(
+                "lane %s reports %d redispatched item(s) but was "
+                "never evicted — only an evicted lane's drain moves "
+                "work" % (lane, entry.get("redispatched_from")))
+    for want, key in ((transitions, "health_transitions"),
+                      (opens, "health_opens"),
+                      (evictions, "health_evictions"),
+                      (redispatches, "health_redispatches"),
+                      (routes, "health_routes_after_open")):
+        if meta.get(key, 0) != want:
+            problems.append(
+                "'Health lanes:' detail recomputes %s=%d but the "
+                "'Health:' line says %d" % (key, want,
+                                            meta.get(key, 0)))
+    # no stranded requests: with the target reached (flag 0) on a
+    # bulk run, every one of the `videos` requests must have
+    # terminated — completed (a table row), dead-lettered, or shed.
+    # (A final fused dispatch may legally overshoot the target, so
+    # only a SHORTFALL is a violation: work stranded behind a lane.)
+    if meta.get("termination_flag") == 0 \
+            and meta.get("mean_interval_ms") == 0 \
+            and isinstance(meta.get("videos"), int):
+        terminated = (num_rows + meta.get("num_failed", 0)
+                      + meta.get("num_shed", 0))
+        if terminated < meta["videos"]:
+            problems.append(
+                "only %d of %d requests terminated (completed + "
+                "failed + shed) on a target-reached chaos run — the "
+                "rest are stranded" % (terminated, meta["videos"]))
+    return problems
+
+
+def _check_deadline(meta: Dict[str, object]) -> List[str]:
+    """Deadline-expiry invariants (rnb_tpu.health): the per-site
+    counts must sum to the total, and the deadline ledger must
+    cross-foot exactly with the deadline-suffixed entries of the shed
+    ledger (two independent code paths counted every shed)."""
+    problems: List[str] = []
+    sites = meta.get("deadline_sites")
+    if "deadline_expired" not in meta:
+        if sites is not None:
+            problems.append("log-meta carries a 'Deadline sites:' "
+                            "line but no 'Deadline:' totals line")
+        return problems
+    if meta.get("deadline_budget_ms", 0) <= 0:
+        problems.append("deadline_budget_ms=%s must be positive"
+                        % meta.get("deadline_budget_ms"))
+    expired = meta.get("deadline_expired", 0)
+    if expired < 0:
+        problems.append("negative deadline_expired")
+    sites = dict(sites or {})
+    if sum(sites.values()) != expired:
+        problems.append(
+            "'Deadline sites:' counts sum to %d but "
+            "deadline_expired=%d (per-site sheds must sum to the "
+            "total)" % (sum(sites.values()), expired))
+    shed_sites = dict(meta.get("shed_sites", {}))
+    suffix = ":deadline_expired"
+    shed_deadline = {k: int(v) for k, v in shed_sites.items()
+                     if k.endswith(suffix)}
+    if shed_deadline != {k: int(v) for k, v in sites.items()}:
+        problems.append(
+            "deadline ledger %s disagrees with the shed ledger's "
+            "deadline-suffixed sites %s (every expiry shed must be "
+            "counted in both)" % (
+                {k: int(v) for k, v in sorted(sites.items())},
+                dict(sorted(shed_deadline.items()))))
+    if expired > meta.get("num_shed", 0):
+        problems.append(
+            "deadline_expired=%d exceeds num_shed=%d (expiry sheds "
+            "are a subset of all sheds)"
+            % (expired, meta.get("num_shed", 0)))
+    return problems
+
+
+def _check_hedge(meta: Dict[str, object]) -> List[str]:
+    """Hedged re-dispatch invariants (rnb_tpu.health): every fired
+    hedge resolves exactly once — the hedge copy wins or the original
+    does — and the loser's burned service is non-negative."""
+    problems: List[str] = []
+    if "hedges_fired" not in meta:
+        return problems
+    for key in ("hedges_fired", "hedges_won", "hedges_lost",
+                "hedges_wasted_ms"):
+        if meta.get(key, 0) < 0:
+            problems.append("negative %s" % key)
+    fired = meta.get("hedges_fired", 0)
+    won = meta.get("hedges_won", 0)
+    lost = meta.get("hedges_lost", 0)
+    if won + lost != fired:
+        problems.append(
+            "hedges_won=%d + hedges_lost=%d != hedges_fired=%d "
+            "(every fired hedge resolves exactly once)"
+            % (won, lost, fired))
+    if fired == 0 and meta.get("hedges_wasted_ms", 0) > 0:
+        problems.append(
+            "hedges_wasted_ms=%d with no hedge fired"
+            % meta["hedges_wasted_ms"])
     return problems
 
 
@@ -1156,9 +1368,12 @@ def main(argv=None) -> int:
         if args.attribute:
             status = max(status, print_attribution(job_dir))
         if args.check:
-            problems = check_job(job_dir)
+            # exit discipline matches the rnb-lint CLI: 2 = the
+            # artifacts could not be parsed (the check never ran), 1 =
+            # parsable artifacts violating an invariant, 0 = clean
+            problems, parse_failed = check_job_detail(job_dir)
             if problems:
-                status = 1
+                status = max(status, 2 if parse_failed else 1)
                 print("%s: INCONSISTENT" % job_dir)
                 for problem in problems:
                     print("  - %s" % problem)

@@ -1,0 +1,13 @@
+"""Bad fixture: unbounded blocking waits on a stage hot path
+(RNB-H009) — a dead producer hangs this consumer forever."""
+
+
+class BlockingStage:
+    def __init__(self, device, in_queue, done_event):
+        self.in_queue = in_queue
+        self.done_event = done_event
+
+    def __call__(self, tensors, non_tensors, time_card):
+        item = self.in_queue.get()          # RNB-H009: no timeout
+        self.done_event.wait()              # RNB-H009: no timeout
+        return item, non_tensors, time_card

@@ -1,0 +1,27 @@
+"""Good fixture: every blocking wait on the hot path is bounded with
+a timeout and re-checks liveness each lap (the RNB-H009 discipline)."""
+
+import queue
+
+
+class BoundedStage:
+    def __init__(self, device, in_queue, done_event, termination):
+        self.in_queue = in_queue
+        self.done_event = done_event
+        self.termination = termination
+
+    def __call__(self, tensors, non_tensors, time_card):
+        while not self.termination.terminated:
+            try:
+                item = self.in_queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            while not self.done_event.wait(timeout=0.05):
+                if self.termination.terminated:
+                    return None, None, None
+            return item, non_tensors, time_card
+        return None, None, None
+
+    def wait(self):
+        # a wait-named leaf is in H009 scope too: bounded is clean
+        self.done_event.wait(timeout=1.0)

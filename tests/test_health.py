@@ -1,0 +1,966 @@
+"""Self-healing replica serving (rnb_tpu.health, PR 10).
+
+Tier-1 coverage of the lane-health/circuit-breaking, deadline-
+propagation and hedged-re-dispatch contracts on the 8-virtual-device
+CPU backend:
+
+* the :class:`LaneHealthBoard` state machine, driven with explicit
+  clocks — every transition path pinned against the legal automaton;
+* the :class:`ReplicaSelector` health gate + the STABLE lowest-lane
+  tie-break under eviction, with the routing sequence for a seeded
+  kill schedule pinned exactly (chaos arms must replay identically);
+* deadline settings/semantics (budget seeded from ``autotune.slo_ms``,
+  fused batches shed only when every member expired);
+* the :class:`HedgeGovernor` exactly-once claim ledger and p95x
+  threshold gating;
+* the new ``replica_crash``/``replica_stall``/``lane`` fault-plan
+  schema;
+* end-to-end: a mid-stream lane kill with eviction + redispatch and
+  every request terminating exactly once; deadline expiry shedding
+  under overload; hedged re-dispatch past a wedged lane with the
+  hedge WINNING and the loser discarded by rid; per-lane shed-site
+  accounting on a full replica lane queue; a contained decode failure
+  inside a fused batch with downstream replicas — all with
+  ``parse_utils --check`` green;
+* the ``--check`` exit-code discipline (2 = parse failure, 1 =
+  invariant violation) and violation fixtures for the new
+  Health:/Deadline:/Hedge: invariants;
+* log-meta byte-stability with every self-healing feature off.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import parse_utils  # noqa: E402
+
+from rnb_tpu.config import ConfigError, parse_config  # noqa: E402
+from rnb_tpu.faults import (FaultPlan, LaneDeathError,  # noqa: E402
+                            classify_error, validate_plan)
+from rnb_tpu.handoff import InflightDepths  # noqa: E402
+from rnb_tpu.health import (EVICTED, HALF_OPEN, HEALTHY,  # noqa: E402
+                            LOSER, OPEN, SUSPECT, UNTRACKED, WINNER,
+                            DeadlineSettings, HealthSettings,
+                            HedgeGovernor, LaneHealthBoard, expired,
+                            legal_path)
+from rnb_tpu.selector import ReplicaSelector  # noqa: E402
+from rnb_tpu.telemetry import TimeCard, TimeCardList  # noqa: E402
+
+
+def _settings(suspect=100.0, open_=300.0, probe=200.0):
+    return HealthSettings(suspect_after_ms=suspect,
+                          open_after_ms=open_,
+                          probe_interval_ms=probe)
+
+
+# -- the lane state machine -------------------------------------------
+
+def test_board_walks_the_full_circuit_and_recovers():
+    board = LaneHealthBoard([4, 5], _settings())
+    t0 = 1000.0
+    board.note_enqueue(4, now=t0)
+    # fresh dispatch: still healthy
+    allowed, probe = board.route_filter([4, 5], now=t0 + 0.05)
+    assert allowed == [4, 5] and probe is None
+    # oldest in-flight item ages past suspect_after_ms
+    allowed, _ = board.route_filter([4, 5], now=t0 + 0.15)
+    assert board.state(4) == SUSPECT
+    assert allowed == [4, 5], "suspect lanes still serve"
+    # past open_after_ms the circuit opens: lane leaves the set
+    allowed, _ = board.route_filter([4, 5], now=t0 + 0.35)
+    assert board.state(4) == OPEN
+    assert allowed == [5]
+    # probe_interval later: half-open, exactly one probe is granted
+    allowed, probe = board.route_filter([4, 5], now=t0 + 0.60)
+    assert probe == 4 and board.state(4) == HALF_OPEN
+    _, probe2 = board.route_filter([4, 5], now=t0 + 0.61)
+    assert probe2 is None, "only one outstanding probe"
+    # the probe settles: the lane heals
+    board.note_settle(4)
+    assert board.state(4) == HEALTHY
+    snap = board.snapshot()
+    assert snap["lane_detail"]["4"]["path"] == [
+        HEALTHY, SUSPECT, OPEN, HALF_OPEN, HEALTHY]
+    assert legal_path(snap["lane_detail"]["4"]["path"])
+    assert snap["opens"] == 1 and snap["probes"] == 1
+    assert snap["transitions"] == 4
+
+
+def test_board_suspect_recovers_without_opening():
+    board = LaneHealthBoard([1, 2], _settings())
+    t0 = 50.0
+    board.note_enqueue(1, now=t0)
+    board.route_filter([1, 2], now=t0 + 0.15)
+    assert board.state(1) == SUSPECT
+    board.note_settle(1)  # the slow dispatch completed after all
+    # recovery needs a suspect_after_ms dwell (anti-flap), so just
+    # after the signal clears the lane stays suspect...
+    board.route_filter([1, 2], now=t0 + 0.20)
+    assert board.state(1) == SUSPECT
+    # ...and heals once it has dwelled clean
+    board.route_filter([1, 2], now=t0 + 0.30)
+    assert board.state(1) == HEALTHY
+    assert board.snapshot()["lane_detail"]["1"]["path"] == [
+        HEALTHY, SUSPECT, HEALTHY]
+
+
+def test_board_fast_failing_lane_trips_on_dead_letters():
+    """A lane that fails every dispatch QUICKLY is low-distress (it
+    beats and settles promptly) — the dead-letter count must trip the
+    circuit anyway, and a still-failing lane must never heal."""
+    from rnb_tpu.health import FAILURE_TRIP_THRESHOLD
+    board = LaneHealthBoard([1, 2], _settings())
+    t0 = 10.0
+    for _ in range(FAILURE_TRIP_THRESHOLD):
+        board.note_failure(1)
+    board.beat(1, now=t0 + 0.05)
+    assert board.state(1) == SUSPECT
+    # fresh failures at the suspect rung escalate to open
+    for _ in range(FAILURE_TRIP_THRESHOLD):
+        board.note_failure(1)
+    board.beat(1, now=t0 + 0.10)
+    assert board.state(1) == OPEN
+    snap = board.snapshot()
+    assert snap["lane_detail"]["1"]["path"] == [HEALTHY, SUSPECT, OPEN]
+    # a suspect lane that KEEPS failing cannot heal even past the
+    # dwell window
+    board2 = LaneHealthBoard([1, 2], _settings())
+    for _ in range(FAILURE_TRIP_THRESHOLD):
+        board2.note_failure(1)
+    board2.beat(1, now=t0 + 0.05)
+    assert board2.state(1) == SUSPECT
+    board2.note_failure(1)
+    board2.beat(1, now=t0 + 0.50)
+    assert board2.state(1) == SUSPECT
+
+
+def test_hedge_discard_counts_only_the_hedged_step_span():
+    """Waste attribution: only the deepest inference span (the losing
+    dispatch itself) counts — shared pre-fork spans were paid once by
+    both copies, and an unfinished losing span counts 0."""
+    gov = HedgeGovernor(5.0)
+    tc = TimeCard(1)
+    tc.record("inference0_start", at=10.0)
+    tc.record("inference0_finish", at=10.08)   # shared 80 ms decode
+    tc.record("inference1_start", at=10.10)
+    tc.record("inference1_finish", at=10.15)   # the losing 50 ms
+    gov.discard(tc)
+    assert abs(gov.wasted_ms - 50.0) < 1.0, gov.wasted_ms
+    # loser that never finished the hedged step: 0, not the shared 80
+    gov2 = HedgeGovernor(5.0)
+    tc2 = TimeCard(2)
+    tc2.record("inference0_start", at=10.0)
+    tc2.record("inference0_finish", at=10.08)
+    tc2.record("inference1_start", at=10.10)   # failed mid-service
+    gov2.discard(tc2)
+    assert gov2.wasted_ms == 0.0
+
+
+def test_board_beat_advances_the_clockwork():
+    """A wedged lane's circuit must open even when the producer never
+    routes again — sibling beats drive the evaluation."""
+    board = LaneHealthBoard([1, 2], _settings())
+    t0 = 10.0
+    board.note_enqueue(1, now=t0)
+    board.beat(2, now=t0 + 0.15)  # the SIBLING's liveness beat
+    assert board.state(1) == SUSPECT
+    board.beat(2, now=t0 + 0.35)
+    assert board.state(1) == OPEN
+
+
+def test_board_stale_beat_with_work_outstanding_is_distress():
+    board = LaneHealthBoard([1], _settings())
+    t0 = 5.0
+    board.beat(1, now=t0)
+    # items keep arriving but the executor stopped beating: the beat
+    # staleness (not just item age) trips the circuit
+    board.note_enqueue(1, now=t0 + 0.29)
+    board.route_filter([1], now=t0 + 0.31)
+    assert board.state(1) == SUSPECT
+    # an IDLE lane (nothing in flight) is silent, not sick
+    board2 = LaneHealthBoard([1], _settings())
+    board2.beat(1, now=t0)
+    board2.route_filter([1], now=t0 + 99.0)
+    assert board2.state(1) == HEALTHY
+
+
+def test_board_eviction_is_terminal_and_legal_from_any_state():
+    for prep in (lambda b, t: None,                       # healthy
+                 lambda b, t: (b.note_enqueue(1, now=t),  # open
+                               b.route_filter([1], now=t + 0.5))):
+        board = LaneHealthBoard([1, 2], _settings())
+        prep(board, 1.0)
+        board.evict(1, "replica-crash")
+        assert board.state(1) == EVICTED
+        board.evict(1, "again")  # idempotent
+        snap = board.snapshot()
+        assert snap["evictions"] == 1
+        assert legal_path(snap["lane_detail"]["1"]["path"])
+        allowed, probe = board.route_filter([1, 2], now=999.0)
+        assert allowed == [2] and probe is None
+
+
+def test_legal_path_rejects_illegal_walks():
+    assert legal_path([HEALTHY])
+    assert legal_path([HEALTHY, SUSPECT, OPEN, HALF_OPEN, OPEN,
+                       HALF_OPEN, HEALTHY])
+    assert not legal_path([SUSPECT, OPEN])          # must start healthy
+    assert not legal_path([HEALTHY, OPEN])          # no skip to open
+    assert not legal_path([HEALTHY, EVICTED, HEALTHY])  # terminal
+    assert not legal_path([])
+
+
+def test_routes_after_open_counts_violations_not_probes():
+    board = LaneHealthBoard([1, 2], _settings())
+    board.note_enqueue(1, now=0.0)
+    # one transition hop per evaluation tick: suspect, then open
+    board.route_filter([1, 2], now=0.15)
+    board.route_filter([1, 2], now=0.5)
+    assert board.state(1) == OPEN
+    board.note_route(1)            # violation: sibling 2 was routable
+    board.note_route(2)
+    board.note_route(1, forced=True)  # exempt: no-sibling fallback
+    snap = board.snapshot()
+    assert snap["routes_after_open"] == 1
+
+
+def test_drained_latch_covers_every_lane():
+    board = LaneHealthBoard([1, 2], _settings())
+    assert not board.all_drained()
+    board.note_drained(1)
+    assert not board.all_drained()
+    board.note_drained(2)
+    assert board.all_drained()
+
+
+def test_health_settings_validation():
+    with pytest.raises(ValueError):
+        HealthSettings(suspect_after_ms=0)
+    with pytest.raises(ValueError):
+        HealthSettings(suspect_after_ms=500, open_after_ms=100)
+    assert HealthSettings.from_config(None) is None
+    assert HealthSettings.from_config({"enabled": False}) is None
+    s = HealthSettings.from_config({"suspect_after_ms": 50})
+    assert s.suspect_after_ms == 50.0
+
+
+# -- selector: health gate + stable tie-break (seeded kill schedule) --
+
+def _bound_selector(lanes, board=None):
+    depths = InflightDepths(lanes)
+    sel = ReplicaSelector(len(lanes))
+    sel.bind_depths(depths, lanes)
+    if board is not None:
+        sel.bind_health(board)
+    return sel, depths
+
+
+def test_replica_selector_tie_break_is_stable_under_eviction():
+    """The regression the seeded chaos arms rely on: with lanes
+    excluded by eviction/circuit-open, the survivors keep their
+    original relative order and the lowest-lane tie-break replays the
+    identical routing sequence for the same depth sequence."""
+    lanes = [3, 4, 5, 6]
+    board = LaneHealthBoard(lanes, _settings())
+    sel, depths = _bound_selector(lanes, board)
+
+    def route():
+        pos = sel.select(None, None, None)
+        q = lanes[pos]
+        depths.inc(q)
+        return q
+
+    # seeded kill schedule: 4 routes healthy, kill lane 4, 6 routes,
+    # kill lane 3, 4 routes — the full sequence is pinned
+    seq = [route() for _ in range(4)]
+    assert seq == [3, 4, 5, 6], seq
+    board.evict(4, "chaos-kill-1")
+    seq2 = [route() for _ in range(6)]
+    # lane 4 is skipped STABLY: survivors 3,5,6 in original order,
+    # least-loaded with lowest-lane tie-break over equal depths
+    assert seq2 == [3, 5, 6, 3, 5, 6], seq2
+    board.evict(3, "chaos-kill-2")
+    seq3 = [route() for _ in range(4)]
+    assert seq3 == [5, 6, 5, 6], seq3
+    # replay: a fresh selector fed the same schedule reproduces the
+    # identical sequence (pure function of depths + board state)
+    board_b = LaneHealthBoard(lanes, _settings())
+    sel_b, depths_b = _bound_selector(lanes, board_b)
+
+    def route_b():
+        pos = sel_b.select(None, None, None)
+        q = lanes[pos]
+        depths_b.inc(q)
+        return q
+
+    replay = [route_b() for _ in range(4)]
+    board_b.evict(4, "chaos-kill-1")
+    replay += [route_b() for _ in range(6)]
+    board_b.evict(3, "chaos-kill-2")
+    replay += [route_b() for _ in range(4)]
+    assert replay == seq + seq2 + seq3
+
+
+def test_replica_selector_routes_probe_to_half_open_lane():
+    lanes = [1, 2]
+    board = LaneHealthBoard(lanes, _settings())
+    sel, depths = _bound_selector(lanes, board)
+    board.note_enqueue(1, now=0.0)
+    board.route_filter(lanes, now=0.15)     # lane 1 -> suspect
+    board.route_filter(lanes, now=0.5)      # lane 1 -> open
+    assert board.state(1) == OPEN
+    # wall clock >> probe deadline: the next select issues the probe
+    pos = sel.select(None, None, None)
+    assert lanes[pos] == 1 and board.state(1) == HALF_OPEN
+    assert board.snapshot()["probes"] == 1
+    assert board.snapshot()["routes_after_open"] == 0
+
+
+def test_replica_selector_forced_route_when_everything_is_down():
+    lanes = [1, 2]
+    board = LaneHealthBoard(lanes, _settings())
+    sel, depths = _bound_selector(lanes, board)
+    board.evict(1, "x")
+    board.evict(2, "y")
+    pos = sel.select(None, None, None)
+    assert lanes[pos] in lanes and sel.last_route_forced
+    assert board.snapshot()["routes_after_open"] == 0  # forced exempt
+
+
+# -- deadline settings + semantics ------------------------------------
+
+def test_deadline_budget_seeds_from_autotune_slo():
+    assert DeadlineSettings.from_config(None) is None
+    assert DeadlineSettings.from_config({"enabled": False}) is None
+    s = DeadlineSettings.from_config({}, {"slo_ms": 80.0})
+    assert s.budget_ms == 80.0
+    s = DeadlineSettings.from_config({"budget_ms": 30}, {"slo_ms": 80})
+    assert s.budget_ms == 30.0
+    s = DeadlineSettings.from_config({})
+    assert s.budget_ms == DeadlineSettings.DEFAULT_BUDGET_MS
+
+
+def test_expired_requires_every_fused_member_blown():
+    a, b = TimeCard(1), TimeCard(2)
+    a.deadline_s, b.deadline_s = 10.0, 20.0
+    fused = TimeCardList([a, b])
+    assert not expired(fused, now=15.0)  # b can still make it
+    assert expired(fused, now=25.0)
+    # undeadlined cards never expire (feature-off runs, exit markers)
+    assert not expired(TimeCard(3), now=1e12)
+    c = TimeCard(4)
+    c.deadline_s = 1.0
+    assert not expired(TimeCardList([a, c, TimeCard(5)]), now=1e12)
+
+
+# -- hedge governor ----------------------------------------------------
+
+def _tracked(gov, rid=7, lane=1, t=100.0):
+    tc = TimeCard(rid)
+    tc.record("enqueue_filename", at=1.0)
+    gov.track(tc, lane, ("payload",), None, now=t)
+    return tc
+
+
+def test_hedge_claim_resolves_exactly_once_each_copy():
+    gov = HedgeGovernor(5.0)
+    tc = _tracked(gov)
+    due = gov.poll(now=100.006)
+    assert len(due) == 1 and due[0].lane == 1
+    assert gov.begin_fire(due[0])
+    assert gov.poll(now=100.1) == [], "a fired hedge never re-fires"
+    # the hedge copy resolves first: WINNER, counted won
+    assert gov.claim(due[0].card) == WINNER
+    assert gov.claim(tc) == LOSER
+    assert gov.claim(tc) == UNTRACKED
+    snap = gov.snapshot()
+    assert (snap["fired"], snap["won"], snap["lost"]) == (1, 1, 0)
+
+
+def test_hedge_original_winning_counts_lost():
+    gov = HedgeGovernor(5.0)
+    tc = _tracked(gov)
+    due = gov.poll(now=101.0)
+    assert gov.begin_fire(due[0])
+    assert gov.claim(tc) == WINNER          # original got there first
+    assert gov.claim(due[0].card) == LOSER
+    snap = gov.snapshot()
+    assert (snap["fired"], snap["won"], snap["lost"]) == (1, 0, 1)
+
+
+def test_hedge_unresolved_at_teardown_counts_lost():
+    gov = HedgeGovernor(5.0)
+    _tracked(gov)
+    assert gov.begin_fire(gov.poll(now=200.0)[0])
+    snap = gov.snapshot()
+    assert snap["won"] + snap["lost"] == snap["fired"] == 1
+
+
+def test_hedge_settled_dispatches_never_hedge():
+    gov = HedgeGovernor(5.0)
+    tc = _tracked(gov)
+    gov.settle(tc, now=100.004)
+    assert gov.poll(now=200.0) == []
+
+
+def test_hedge_never_fires_for_an_already_resolved_dispatch():
+    """The fire-after-resolve race: a dispatch that completed (claim
+    ran, returned UNTRACKED) between the producer's poll() and its
+    enqueue must NOT be hedged — begin_fire re-checks under the same
+    lock claim() settles in, so the late copy can never claim WINNER
+    and publish the request a second time."""
+    gov = HedgeGovernor(5.0)
+    tc = _tracked(gov)
+    due = gov.poll(now=200.0)
+    assert len(due) == 1
+    # the consumer resolves the dispatch while the producer holds its
+    # poll snapshot
+    assert gov.claim(tc, now=200.0) == UNTRACKED
+    assert gov.begin_fire(due[0]) is False
+    snap = gov.snapshot()
+    assert snap["fired"] == 0
+    # and once resolved it never re-enters the poll window either
+    assert gov.poll(now=300.0) == []
+
+
+def test_hedge_begin_fire_is_exactly_once_and_cancelable():
+    gov = HedgeGovernor(5.0)
+    _tracked(gov)
+    due = gov.poll(now=200.0)
+    assert gov.begin_fire(due[0]) is True
+    assert gov.begin_fire(due[0]) is False  # double-fire blocked
+    gov2 = HedgeGovernor(5.0)
+    _tracked(gov2)
+    entry = gov2.poll(now=200.0)[0]
+    assert gov2.begin_fire(entry) is True
+    gov2.cancel_fire(entry)  # sibling queue was full: roll back
+    assert gov2.snapshot()["fired"] == 0
+    # the entry is hedgeable again on a later tick
+    entry2 = gov2.poll(now=300.0)
+    assert len(entry2) == 1
+
+
+def test_hedge_p95x_needs_samples_then_tracks_latency():
+    gov = HedgeGovernor("p95x")
+    assert gov.threshold_ms() is None  # cold: never hedge
+    for i in range(6):
+        tc = TimeCard(i)
+        gov.track(tc, 1, None, None, now=10.0 + i)
+        gov.settle(tc, now=10.0 + i + 0.010)  # 10 ms settles
+    thr = gov.threshold_ms()
+    assert thr is not None and 10.0 <= thr < 50.0
+    # an untracked rid claims UNTRACKED (no hedge was ever fired)
+    assert gov.claim(TimeCard(99)) == UNTRACKED
+
+
+def test_hedge_clone_is_stamp_complete_and_marked():
+    from rnb_tpu.health import clone_cards
+    tc = TimeCard(3)
+    tc.record("enqueue_filename", at=1.0)
+    tc.num_clips = 2
+    clone = clone_cards(tc)
+    assert clone.id == 3 and clone.hedge_copy
+    assert clone.timings == tc.timings and clone.num_clips == 2
+    clone.record("inference1_start", at=2.0)
+    assert "inference1_start" not in tc.timings, "distinct objects"
+    fused = TimeCardList([TimeCard(1), TimeCard(2)])
+    cl = clone_cards(fused)
+    assert [c.id for c in cl.time_cards] == [1, 2]
+    assert all(c.hedge_copy for c in cl.time_cards)
+
+
+# -- fault-plan schema for lane deaths --------------------------------
+
+def test_fault_plan_accepts_and_fires_lane_kinds():
+    plan = FaultPlan({"faults": [
+        {"kind": "replica_crash", "step": 1, "lane": 3,
+         "probability": 1.0}]})
+    with pytest.raises(LaneDeathError) as e:
+        plan.fire(1, [5], lane=3)
+    assert e.value.fate == "crash"
+    plan.fire(1, [5], lane=2)   # other lane: nothing fires
+    plan.fire(0, [5], lane=3)   # other step: nothing fires
+    plan.fire(1, [5], lane=3, attempt=1)  # retries never re-kill
+    # a stall wedges then dies
+    plan2 = FaultPlan({"faults": [
+        {"kind": "replica_stall", "step": 1, "ms": 0,
+         "probability": 1.0}]})
+    with pytest.raises(LaneDeathError) as e2:
+        plan2.fire(1, [5], lane=0)
+    assert e2.value.fate == "stall"
+    # LaneDeathError escaping to classification is FATAL (a chaos
+    # plan aimed at a lane-less step must abort loudly)
+    assert classify_error(e2.value) == "fatal"
+
+
+def test_fault_plan_rejects_bad_lane_kind_specs():
+    with pytest.raises(ValueError):
+        validate_plan({"faults": [
+            {"kind": "replica_crash", "probability": 1.0, "ms": 5}]})
+    with pytest.raises(ValueError):
+        validate_plan({"faults": [
+            {"kind": "replica_stall", "probability": 1.0}]})  # no ms
+    with pytest.raises(ValueError):
+        validate_plan({"faults": [
+            {"kind": "replica_crash", "probability": 1.0,
+             "times": 2}]})
+    with pytest.raises(ValueError):
+        validate_plan({"faults": [
+            {"kind": "replica_crash", "probability": 1.0,
+             "lane": -1}]})
+    validate_plan({"faults": [
+        {"kind": "replica_stall", "ms": 10, "lane": 2,
+         "request_ids": [1]}]})
+    # ANY kind may be lane-addressed: a lane-scoped latency/stall is
+    # the slow-lane chaos class, error kinds a lane-local fault domain
+    validate_plan({"faults": [
+        {"kind": "stall", "ms": 10, "lane": 1, "probability": 0.5},
+        {"kind": "transient", "probability": 1.0, "lane": 1}]})
+
+
+def test_lane_addressed_slow_lane_faults_fire_per_lane():
+    plan = FaultPlan({"faults": [
+        {"kind": "stall", "step": 1, "ms": 50, "lane": 2,
+         "probability": 1.0}]})
+    assert plan.stall_ms(1, [0], lane=2) == 50.0
+    assert plan.stall_ms(1, [0], lane=3) == 0.0
+    assert plan.stall_ms(1, [0]) == 0.0  # lane-less site never matches
+    plan2 = FaultPlan({"faults": [
+        {"kind": "permanent", "step": 1, "lane": 2,
+         "probability": 1.0}]})
+    plan2.fire(1, [0], lane=3)  # other lane: clean
+    with pytest.raises(Exception):
+        plan2.fire(1, [0], lane=2)
+
+
+# -- config schema ----------------------------------------------------
+
+def _cfg(step_extra=None, root_extra=None):
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "replicas": 2,
+             "queue_groups": [{"devices": [1, 2], "in_queue": 0}]},
+        ],
+    }
+    if step_extra:
+        cfg["pipeline"][1].update(step_extra)
+    if root_extra:
+        cfg.update(root_extra)
+    return cfg
+
+
+def test_config_accepts_and_rejects_health_deadline_hedge():
+    cfg = parse_config(_cfg(
+        step_extra={"hedge_ms": "p95x"},
+        root_extra={"health": {"suspect_after_ms": 50},
+                    "deadline": {"budget_ms": 100}}))
+    assert cfg.health == {"suspect_after_ms": 50}
+    assert cfg.deadline == {"budget_ms": 100}
+    assert cfg.steps[1].hedge_ms == "p95x"
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(root_extra={"health": {"bogus": 1}}))
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(root_extra={
+            "health": {"suspect_after_ms": 500,
+                       "open_after_ms": 100}}))
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(root_extra={"deadline": {"budget_ms": 0}}))
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(step_extra={"hedge_ms": "p99x"}))
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(step_extra={"hedge_ms": -5}))
+    # hedge_ms needs replica lanes to re-dispatch onto
+    bad = _cfg(step_extra={"hedge_ms": 5})
+    del bad["pipeline"][1]["replicas"]
+    bad["pipeline"][1]["queue_groups"][0]["devices"] = [1]
+    with pytest.raises(ConfigError):
+        parse_config(bad)
+
+
+# -- end-to-end --------------------------------------------------------
+
+def _run(cfg, videos=16, **kwargs):
+    from rnb_tpu.benchmark import run_benchmark
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "cfg.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        res = run_benchmark(path, mean_interval_ms=0,
+                            num_videos=videos, queue_size=64,
+                            log_base=tmp, print_progress=False,
+                            seed=5, **kwargs)
+        problems, parse_failed = parse_utils.check_job_detail(
+            res.log_dir)
+        with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+            meta_text = f.read()
+        res.parsed_meta = parse_utils.parse_meta(res.log_dir)
+        return res, problems, parse_failed, meta_text
+
+
+def test_e2e_lane_crash_contained_and_redispatched():
+    """A replica lane crashing mid-stream: the in-service dispatch
+    dead-letters, queued work moves to the healthy sibling, every
+    request terminates exactly once, the selector never feeds the
+    dead lane after eviction — and --check agrees."""
+    cfg = _cfg(root_extra={
+        "health": {"suspect_after_ms": 100, "open_after_ms": 300,
+                   "probe_interval_ms": 200},
+        "fault_plan": {"faults": [
+            {"kind": "replica_crash", "step": 1, "lane": 1,
+             "probability": 1.0},
+            {"kind": "latency", "step": 1, "probability": 1.0,
+             "ms": 30}]}})
+    res, problems, _pf, meta_text = _run(cfg)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    assert res.num_completed + res.num_failed + res.num_shed == 16
+    assert res.num_failed >= 1
+    assert res.failure_reasons.get("replica-crash") == res.num_failed
+    assert res.health_evictions == 1
+    assert res.health_lane_detail["1"]["state"] == EVICTED
+    assert res.health_routes_after_open == 0
+    assert "Health:" in meta_text and "Health lanes:" in meta_text
+
+
+def test_e2e_multi_instance_lane_death_drains_after_last_instance():
+    """A lane carrying TWO executor instances (a multi-device
+    sub-mesh per replica): a lane-addressed kill takes both down —
+    only the LAST death may drain the queue (the first dying instance
+    must leave the survivor's work alone), and nothing strands."""
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "health": {"suspect_after_ms": 200, "open_after_ms": 600,
+                   "probe_interval_ms": 400},
+        "fault_plan": {"faults": [
+            {"kind": "replica_crash", "step": 1, "lane": 1,
+             "probability": 1.0},
+            {"kind": "latency", "step": 1, "probability": 1.0,
+             "ms": 30}]},
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            # replicas 2 over 4 devices -> 2 instances per lane
+            {"model": "tests.pipeline_helpers.TinySink", "replicas": 2,
+             "queue_groups": [{"devices": [1, 2, 3, 4],
+                               "in_queue": 0}]},
+        ],
+    }
+    res, problems, _pf, _meta = _run(cfg, videos=20)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    # both instances of lane 1 die (one dead-letter each), everything
+    # else terminates exactly once on the surviving lane
+    assert res.num_completed + res.num_failed + res.num_shed == 20
+    assert res.num_failed == 2
+    assert res.failure_reasons == {"replica-crash": 2}
+    assert res.health_evictions == 1
+    assert res.health_lane_detail["1"]["state"] == EVICTED
+
+
+def test_lane_faults_without_health_are_rejected_at_launch():
+    """A lane death without the health layer cannot be contained (no
+    eviction, no drain, no sibling linger) — the launcher must fail
+    fast instead of letting the run hang to the barrier timeout."""
+    cfg = _cfg(root_extra={"fault_plan": {"faults": [
+        {"kind": "replica_crash", "step": 1, "lane": 1,
+         "probability": 1.0}]}})
+    from rnb_tpu.benchmark import run_benchmark
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "cfg.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        with pytest.raises(ValueError, match="health"):
+            run_benchmark(path, mean_interval_ms=0, num_videos=4,
+                          queue_size=16, log_base=tmp,
+                          print_progress=False, seed=1)
+
+
+def test_e2e_deadline_sheds_expired_work_with_check_green():
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "deadline": {"budget_ms": 150},
+        "overload_policy": "shed",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "tests.pipeline_helpers.TinySlowSink",
+             "delay_s": 0.05,
+             "queue_groups": [{"devices": [1], "in_queue": 0}]},
+        ],
+    }
+    res, problems, _pf, meta_text = _run(cfg, videos=20)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    assert res.deadline_expired > 0
+    assert res.deadline_expired == res.num_shed
+    assert sum(res.deadline_sites.values()) == res.deadline_expired
+    assert all(site.endswith(":deadline_expired")
+               for site in res.deadline_sites)
+    # doomed work was dropped BEFORE service, not after: completions
+    # + expiry sheds partition the stream
+    assert res.num_completed + res.num_shed == 20
+    assert "Deadline:" in meta_text and "Deadline sites:" in meta_text
+
+
+def test_e2e_hedge_wins_past_a_wedged_lane():
+    """One lane wedges on its first dispatch (a 'stall' fault, no
+    death): the hedge re-issues that dispatch on the healthy sibling,
+    the hedge copy WINS, the wedged original resolves later as the
+    loser and is discarded by rid — every request still terminates
+    exactly once and the waste is accounted."""
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "health": {"suspect_after_ms": 5000, "open_after_ms": 10000,
+                   "probe_interval_ms": 5000},
+        # the slow-lane chaos class: the stall is LANE-addressed, so
+        # only lane 1's copy wedges — the hedge re-issued on lane 2
+        # runs clean (an un-addressed stall would wedge both copies
+        # and the hedge could never win)
+        "fault_plan": {"faults": [
+            {"kind": "stall", "step": 1, "ms": 1200, "lane": 1,
+             "request_ids": [0]}]},
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            # a sink with real (50 ms) service so the discarded
+            # loser's burned span is measurable in hedges_wasted_ms
+            {"model": "tests.pipeline_helpers.TinySlowSink",
+             "delay_s": 0.05, "replicas": 2, "hedge_ms": 100,
+             "queue_groups": [{"devices": [1, 2], "in_queue": 0}]},
+        ],
+    }
+    res, problems, _pf, meta_text = _run(cfg, videos=10)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    assert res.num_completed == 10 and res.num_failed == 0
+    assert res.hedges_fired >= 1
+    assert res.hedges_won + res.hedges_lost == res.hedges_fired
+    assert res.hedges_won >= 1, (
+        "the wedged original should lose to the hedge copy")
+    assert res.hedges_wasted_ms > 0
+    assert "Hedge:" in meta_text
+
+
+def test_e2e_full_replica_lane_queue_sheds_per_lane():
+    """Satellite: shed-at-full-queue on a *replica* lane queue — the
+    shed site names the lane, so per-lane accounting survives the
+    replica expansion."""
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "overload_policy": "shed",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}]},
+            {"model": "tests.pipeline_helpers.TinySlowSink",
+             "delay_s": 0.05, "replicas": 2,
+             "queue_groups": [{"devices": [1, 2], "in_queue": 0}]},
+        ],
+    }
+    from rnb_tpu.benchmark import run_benchmark
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "cfg.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        # Poisson mode keeps the configured (tiny) queue size, so the
+        # lane queues really fill; bulk mode would resize them
+        res = run_benchmark(path, mean_interval_ms=1, num_videos=40,
+                            queue_size=2, log_base=tmp,
+                            print_progress=False, seed=5)
+        problems = parse_utils.check_job(res.log_dir)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    assert res.num_shed > 0
+    lane_sites = [s for s in res.shed_sites
+                  if s.startswith("step0_out_queue.lane")]
+    assert lane_sites, ("replica-lane sheds must carry per-lane "
+                        "sites, got %s" % res.shed_sites)
+
+
+def _write_tiny_dataset(root):
+    """3 valid 2-frame y4m videos + 1 corrupt one in a label subtree
+    (the test_fault_containment fixture shape)."""
+    import numpy as np
+    from rnb_tpu.decode import write_y4m
+    label = os.path.join(root, "label0")
+    os.makedirs(label, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        frames = rng.integers(0, 256, (4, 16, 16, 3), dtype=np.uint8)
+        write_y4m(os.path.join(label, "ok%d.y4m" % i), frames,
+                  colorspace="420")
+    with open(os.path.join(label, "bad.y4m"), "wb") as f:
+        f.write(b"NOT_A_Y4M_STREAM totally corrupt payload\n")
+
+
+@pytest.mark.chaos
+def test_e2e_contained_decode_failure_with_replica_siblings(
+        tmp_path, monkeypatch):
+    """Satellite: a REAL decode failure contained inside a fused
+    batch (the loader's take_failed path, not an executor-level
+    injection) while the surviving fused emissions route across two
+    replica lanes — the corrupt video dead-letters, its batchmates
+    complete on whichever lane they landed, --check green."""
+    data_root = str(tmp_path / "data")
+    _write_tiny_dataset(data_root)
+    monkeypatch.setenv("RNB_TPU_DATA_ROOT", data_root)
+    cfg = {
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DFusingLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "max_clips": 2, "consecutive_frames": 2, "fuse": 2,
+             "num_clips_population": [1], "weights": [1],
+             "num_warmups": 0},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "replicas": 2,
+             "queue_groups": [{"devices": [1, 2], "in_queue": 0}]},
+        ],
+    }
+    # 8 requests cycling 4 files (sorted: bad, ok0..ok2): the corrupt
+    # video is fused into a batch exactly twice
+    res, problems, _pf, _meta = _run(cfg, videos=8)
+    assert problems == [], problems
+    assert res.termination_flag == 0
+    assert res.num_failed == 2
+    assert res.failure_reasons == {"corrupt-video": 2}
+    assert res.num_completed == 6
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_shipped_chaos_arm_contains_a_replica_loss():
+    """The tier-1-adjacent gate as a registered chaos test: the
+    shipped 4-replica chaos arm (`make chaos`) must contain a seeded
+    mid-stream lane loss end-to-end."""
+    import chaos_demo
+    assert chaos_demo.main() == 0
+
+
+def test_e2e_features_off_keeps_logs_byte_stable():
+    res, problems, _pf, meta_text = _run(_cfg(), videos=8)
+    assert problems == [], problems
+    for line in ("Health", "Deadline", "Hedge"):
+        assert line not in meta_text, line
+    meta = res.parsed_meta
+    assert "health_lanes" not in meta
+    assert "deadline_expired" not in meta
+    assert "hedges_fired" not in meta
+
+
+# -- --check: violation fixtures + exit codes -------------------------
+
+def _job(tmp_path, extra_meta="", table=True):
+    job = tmp_path / "job"
+    job.mkdir()
+    (job / "log-meta.txt").write_text(
+        "Args: Namespace(mean_interval_ms=0, batch_size=1, videos=1, "
+        "queue_size=1, config_file_path='x.json')\n"
+        "1.0 2.0\n"
+        "Termination flag: 0\n"
+        "Faults: num_failed=0 num_shed=0 num_retries=0\n"
+        + extra_meta)
+    if table:
+        (job / "cpu0-group0-0.txt").write_text(
+            "enqueue_filename inference1_finish device0\n"
+            "1.0 1.5 ('cpu:0',)\n")
+    return str(job)
+
+
+def test_check_flags_illegal_lane_path(tmp_path):
+    job = _job(tmp_path,
+               "Health: lanes=1 transitions=1 opens=1 evictions=0 "
+               "probes=0 redispatches=0 routes_after_open=0\n"
+               'Health lanes: {"1": {"state": "open", "path": '
+               '["healthy", "open"], "redispatched_from": 0, '
+               '"routes_after_open": 0}}\n')
+    problems = parse_utils.check_job(job)
+    assert any("not a legal walk" in p for p in problems), problems
+
+
+def test_check_flags_routes_after_open(tmp_path):
+    job = _job(tmp_path,
+               "Health: lanes=1 transitions=0 opens=0 evictions=0 "
+               "probes=0 redispatches=0 routes_after_open=2\n"
+               'Health lanes: {"1": {"state": "healthy", "path": '
+               '["healthy"], "redispatched_from": 0, '
+               '"routes_after_open": 2}}\n')
+    problems = parse_utils.check_job(job)
+    assert any("circuit containment violated" in p
+               for p in problems), problems
+
+
+def test_check_flags_redispatch_without_eviction(tmp_path):
+    job = _job(tmp_path,
+               "Health: lanes=1 transitions=0 opens=0 evictions=0 "
+               "probes=0 redispatches=3 routes_after_open=0\n"
+               'Health lanes: {"1": {"state": "healthy", "path": '
+               '["healthy"], "redispatched_from": 3, '
+               '"routes_after_open": 0}}\n')
+    problems = parse_utils.check_job(job)
+    assert any("never evicted" in p for p in problems), problems
+
+
+def test_check_flags_deadline_site_mismatch(tmp_path):
+    job = _job(tmp_path,
+               "Shed sites: {\"step1_take:deadline_expired\": 2}\n"
+               "Deadline: budget_ms=100 expired=3\n"
+               "Deadline sites: {\"step1_take:deadline_expired\": "
+               "3}\n")
+    problems = parse_utils.check_job(job)
+    assert any("disagrees with the shed ledger" in p
+               for p in problems), problems
+
+
+def test_check_flags_hedge_resolution_leak(tmp_path):
+    job = _job(tmp_path,
+               "Hedge: fired=3 won=1 lost=1 wasted_ms=4\n")
+    problems = parse_utils.check_job(job)
+    assert any("resolves exactly once" in p for p in problems), \
+        problems
+
+
+def test_check_flags_stranded_requests(tmp_path):
+    job = _job(tmp_path,
+               "Health: lanes=1 transitions=0 opens=0 evictions=0 "
+               "probes=0 redispatches=0 routes_after_open=0\n"
+               'Health lanes: {"1": {"state": "healthy", "path": '
+               '["healthy"], "redispatched_from": 0, '
+               '"routes_after_open": 0}}\n')
+    # the Args line says videos=1 and the table holds 1 row, so the
+    # run is complete; rewrite Args to claim 5 videos -> 4 stranded
+    meta = open(os.path.join(job, "log-meta.txt")).read()
+    with open(os.path.join(job, "log-meta.txt"), "w") as f:
+        f.write(meta.replace("videos=1,", "videos=5,"))
+    problems = parse_utils.check_job(job)
+    assert any("stranded" in p for p in problems), problems
+
+
+def test_check_exit_codes_distinguish_parse_from_invariant(tmp_path):
+    # invariant violation over parsable artifacts -> exit 1
+    bad = _job(tmp_path, "Hedge: fired=2 won=0 lost=1 wasted_ms=0\n")
+    assert parse_utils.main(["--check", bad]) == 1
+    # schema-parse failure (no log-meta at all) -> exit 2
+    empty = tmp_path / "empty-job"
+    empty.mkdir()
+    assert parse_utils.main(["--check", str(empty)]) == 2
+    # a clean job -> 0
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    ok = _job(sub, "")
+    assert parse_utils.main(["--check", ok]) == 0

@@ -145,12 +145,22 @@ HOTPATH_CASES = [
     ("bad_h006_sync.py", "RNB-H006"),
     ("bad_h007_alloc.py", "RNB-H007"),
     ("bad_h008_handoff.py", "RNB-H008"),
+    ("bad_h009_block.py", "RNB-H009"),
 ]
 
 
 def test_good_hotpath_fixture_is_clean():
     from rnb_tpu.analysis.hotpath import check_file
     assert check_file(_fixture("good_hot.py"), root=FIXTURES) == []
+
+
+def test_good_h009_fixture_is_clean():
+    # timeout-bounded waits with a liveness re-check each lap are the
+    # sanctioned shape (the runner's own queue polls); RNB-H009 must
+    # stay quiet on them — including on a wait-named leaf method
+    from rnb_tpu.analysis.hotpath import check_file
+    assert check_file(_fixture("good_h009_wait.py"),
+                      root=FIXTURES) == []
 
 
 def test_good_handoff_fixture_is_clean():
@@ -282,6 +292,11 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Handoff: edges=%d\\n" % ho)\n'
                      'f.write("Handoff edges: %s\\n" % he)\n'
                      'f.write("Placement: %s\\n" % pl)\n'
+                     'f.write("Health: lanes=%d\\n" % hl)\n'
+                     'f.write("Health lanes: %s\\n" % hd)\n'
+                     'f.write("Deadline: budget_ms=%d\\n" % dl)\n'
+                     'f.write("Deadline sites: %s\\n" % ds)\n'
+                     'f.write("Hedge: fired=%d\\n" % hg)\n'
                      'f.write("Compiles: %s\\n" % c)\n'
                      'f.write("Warmup: %s\\n" % w)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
@@ -323,7 +338,13 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'f.write("Padding: pad_rows=%d total_rows=%d '
         'pad_emissions=%d\\n" % p)\n'
         'f.write("Handoff: edges=%d d2d_edges=%d host_edges=%d '
-        'd2d_bytes=%d host_bytes=%d\\n" % h)\n')
+        'd2d_bytes=%d host_bytes=%d\\n" % h)\n'
+        'f.write("Health: lanes=%d transitions=%d opens=%d '
+        'evictions=%d probes=%d redispatches=%d '
+        'routes_after_open=%d\\n" % hl)\n'
+        'f.write("Deadline: budget_ms=%d expired=%d\\n" % dl)\n'
+        'f.write("Hedge: fired=%d won=%d lost=%d wasted_ms=%d\\n" '
+        '% hg)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}

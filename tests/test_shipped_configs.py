@@ -96,6 +96,37 @@ def test_scaleout_arms_ship_executed_and_scale():
         "idle host or retune the arms" % ratio)
 
 
+def test_chaos_arm_ships_executed_with_the_full_healing_layer():
+    """The replica-loss chaos arm (PR 10 self-healing) must land in
+    BOTH configs/ and the matrix with an ok execution row, and must
+    actually declare the whole healing surface — lane health, a
+    lane-addressed replica_stall kill, p95x hedging on the replicated
+    step — so `make chaos` exercises circuit breaking + eviction +
+    redispatch, not a watered-down arm."""
+    rel = "configs/rnb-scaleout-r4-chaos.json"
+    path = os.path.join(REPO, rel)
+    assert os.path.exists(path), rel
+    from rnb_tpu.config import load_config
+    cfg = load_config(path)
+    assert cfg.health is not None
+    assert cfg.steps[1].replica_queues is not None
+    assert len(cfg.steps[1].replica_queues) == 4
+    assert cfg.steps[1].hedge_ms == "p95x"
+    kinds = {f["kind"] for f in cfg.fault_plan["faults"]}
+    assert "replica_stall" in kinds, (
+        "the chaos arm must kill a lane mid-stream (replica_stall/"
+        "replica_crash), got fault kinds %s" % sorted(kinds))
+    lane_faults = [f for f in cfg.fault_plan["faults"]
+                   if f["kind"] == "replica_stall"]
+    assert lane_faults[0]["lane"] in cfg.steps[1].replica_queues
+    with open(ARTIFACT) as f:
+        rows = {r["config"]: r for r in json.load(f)["configs"]}
+    assert rel in rows and rows[rel].get("ok"), (
+        "the chaos arm has no ok execution row — run "
+        "scripts/run_shipped_configs.py --only "
+        "'rnb-scaleout-r4-chaos.json'")
+
+
 def test_every_executed_config_is_still_shipped():
     """The reverse direction: MULTICHIP_CONFIGS.json and configs/ stay
     in sync BOTH ways. A row for a config that no longer ships is a
