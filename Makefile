@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace ragged multichip chaos
+.PHONY: lint test native stamps trace ragged multichip chaos metrics
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -52,6 +52,16 @@ multichip:
 # Health:/Deadline:/Hedge: invariants. Exit 0 = containment holds.
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_demo.py
+
+# Live-metrics gate (README "Live metrics"): a metrics+deadline arm
+# asserting >= 3 streamed snapshots, final-snapshot footing against
+# the BenchmarkResult ledgers, a forced flight dump valid per
+# validate_trace, and parse_utils --check green — plus the chaos arm
+# (rnb-scaleout-r4-chaos.json + metrics) asserting the seeded lane
+# kill produces a circuit-open flight dump. Exit 0 = the live plane
+# streams, foots, and black-boxes incidents.
+metrics:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_demo.py
 
 native:
 	$(MAKE) -C native

@@ -230,6 +230,22 @@ class BenchmarkResult:
     hedges_won: int = 0
     hedges_lost: int = 0
     hedges_wasted_ms: int = 0
+    #: live-metrics plane accounting (rnb_tpu.metrics, root `metrics`
+    #: config key): interval snapshots appended to metrics.jsonl,
+    #: distinct series at teardown, flight-recorder dumps written and
+    #: triggers observed — all zero without the key. --check holds
+    #: the final snapshot's counters to the ledger lines exactly.
+    metrics_snapshots: int = 0
+    metrics_series: int = 0
+    metrics_dumps: int = 0
+    metrics_triggers: int = 0
+    #: live SLO-layer accounting (same gating): completions tracked /
+    #: within deadline / missed, plus the run's peak burn rate in
+    #: milli-units (1000 = consuming the error budget exactly)
+    slo_tracked: int = 0
+    slo_within: int = 0
+    slo_missed: int = 0
+    slo_burn_max_milli: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -250,6 +266,7 @@ def run_benchmark(config_path: str,
     # (SURVEY.md §2.4 TPU mapping; no-op for single-host runs)
     from rnb_tpu.parallel.distributed import maybe_initialize
     maybe_initialize()
+    from rnb_tpu import metrics as metrics_mod
     from rnb_tpu import trace as trace_mod
     from rnb_tpu.client import bulk_client, poisson_client
     from rnb_tpu.config import load_config
@@ -262,8 +279,9 @@ def run_benchmark(config_path: str,
     # defensive: a previous run that died mid-trace must not leave its
     # tracer active — this run's instrumentation would otherwise write
     # into a dead collector (and un-traced runs would stop being
-    # byte-stable)
+    # byte-stable); same for the live-metrics registry
     trace_mod.ACTIVE = None
+    metrics_mod.ACTIVE = None
 
     config = load_config(config_path)
     config.check_devices()
@@ -460,6 +478,67 @@ def run_benchmark(config_path: str,
                 edge_idx += 1
         trace_mod.ACTIVE = tracer
 
+    # live metrics plane (rnb_tpu.metrics, root 'metrics' config key):
+    # a time-series registry + background flusher streaming interval
+    # snapshots to logs/<job>/metrics.jsonl while the run is live. It
+    # BRIDGES existing signals instead of re-measuring: a SpanBridge
+    # installs as the trace collector (forwarding to the real tracer
+    # when tracing is also on) so the hot-loop spans feed latency
+    # histograms and the flight-recorder ring, and the shared ledgers
+    # (faults, deadline, hedge, health) + queue depths become poll
+    # sources read each tick. Stage-owned subsystems register in the
+    # runner (metrics.register_stage).
+    metrics_registry = None
+    metrics_settings = metrics_mod.MetricsSettings.from_config(
+        config.metrics)
+    if metrics_settings is not None:
+        slo_budget = None
+        if deadline_settings is not None:
+            slo_budget = deadline_settings.budget_ms
+        elif autotune_settings is not None:
+            slo_budget = autotune_settings.slo_ms
+        metrics_registry = metrics_mod.MetricsRegistry(
+            metrics_settings, job_dir=logroot(job_id, base=log_base),
+            job_id=job_id, slo_budget_ms=slo_budget)
+        metrics_registry.add_gauge_source(
+            metrics_mod.name("queue.filename.depth"),
+            fabric.get_filename_queue().qsize,
+            capacity=effective_queue_size)
+        edge_idx = 0
+        for step_queues in fabric.queues:
+            for q_idx in sorted(step_queues):
+                metrics_registry.add_gauge_source(
+                    metrics_mod.name("queue.e%d.depth", edge_idx),
+                    step_queues[q_idx].qsize,
+                    capacity=effective_queue_size)
+                edge_idx += 1
+        metrics_registry.add_poll(metrics_mod.snapshot_poll(
+            "faults", fault_stats.snapshot,
+            counters=("num_failed", "num_shed", "num_retries")))
+        if deadline_stats is not None:
+            metrics_registry.add_poll(metrics_mod.snapshot_poll(
+                "deadline", deadline_stats.snapshot,
+                counters=("expired",)))
+        for gov in governors_by_step.values():
+            # live_counters, NOT snapshot(): the teardown snapshot
+            # resolves leftover hedges, and a per-tick poll must
+            # never perturb the claim ledger
+            metrics_registry.add_poll(metrics_mod.snapshot_poll(
+                "hedge", gov.live_counters,
+                counters=("fired", "won", "lost")))
+        for board in boards_by_step.values():
+            metrics_registry.add_poll(metrics_mod.snapshot_poll(
+                "health", board.snapshot,
+                counters=("transitions", "opens", "evictions",
+                          "probes", "redispatches")))
+        bridge = metrics_mod.SpanBridge(
+            metrics_registry, forward=tracer,
+            ring_events=(metrics_settings.ring_events
+                         if metrics_settings.flight_enabled else 0))
+        metrics_registry.bridge = bridge
+        trace_mod.ACTIVE = bridge
+        metrics_mod.ACTIVE = metrics_registry
+
     threads = []
     client_kwargs = dict(overload_policy=config.overload_policy,
                          fault_stats=fault_stats, counter=counter,
@@ -627,6 +706,11 @@ def run_benchmark(config_path: str,
         # short drain); started here so warm-up/compile never lands
         # in the timeline
         tracer.start_sampler()
+    if metrics_registry is not None:
+        # the flusher covers the measured window: every poll source
+        # is registered by now (runner registration happens before
+        # the start barrier)
+        metrics_registry.start()
     sta_bar.wait()
     ru_start = resource.getrusage(resource.RUSAGE_SELF)
     time_start = time.time()
@@ -685,6 +769,13 @@ def run_benchmark(config_path: str,
 
     for t in threads:
         t.join(timeout=60)
+
+    if metrics_registry is not None:
+        # stop bridging the trace hooks (the tracer export below
+        # reads its own buffer, not the module hook); the registry
+        # itself keeps running until the final footing flush after
+        # every ledger snapshot settled
+        trace_mod.ACTIVE = None
 
     # trace export: every thread is drained, so the event set is
     # final; clear the module hook BEFORE exporting so a later run in
@@ -780,6 +871,18 @@ def run_benchmark(config_path: str,
         placement_report = build_report(placement_sink, total_time,
                                         len(jax.devices()),
                                         placement_settings.mode)
+
+    metrics_summary = None
+    if metrics_registry is not None:
+        # the FINAL footing flush: every pipeline thread joined and
+        # every ledger snapshot above settled (the hedge snapshot
+        # resolves leftover unresolved hedges), so this last
+        # metrics.jsonl record's counters must equal the log-meta
+        # ledgers exactly — parse_utils --check asserts it. Also
+        # services the forced-dump env hook and writes metrics.prom.
+        metrics_registry.stop()
+        metrics_mod.ACTIVE = None
+        metrics_summary = metrics_registry.summary()
 
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
@@ -950,6 +1053,24 @@ def run_benchmark(config_path: str,
             # per request (parse_utils --check asserts it)
             f.write("Phases: %s\n"
                     % json.dumps(phases_stats, sort_keys=True))
+        if metrics_summary is not None:
+            # only metrics-enabled runs carry the lines, keeping
+            # metrics-off logs byte-stable with the earlier schema;
+            # --check cross-foots metrics.jsonl's final snapshot
+            # against the ledger lines above and validates every
+            # flight dump per validate_trace
+            f.write("Metrics: snapshots=%d series=%d dumps=%d "
+                    "triggers=%d\n"
+                    % (metrics_summary["snapshots"],
+                       metrics_summary["series"],
+                       metrics_summary["dumps"],
+                       metrics_summary["triggers"]))
+            f.write("Slo: tracked=%d within=%d missed=%d "
+                    "burn_max_milli=%d\n"
+                    % (metrics_summary["slo_tracked"],
+                       metrics_summary["slo_within"],
+                       metrics_summary["slo_missed"],
+                       metrics_summary["burn_max_milli"]))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -1031,6 +1152,17 @@ def run_benchmark(config_path: str,
                  deadline_snap["expired"],
                  ", ".join("%s=%d" % kv for kv in sorted(
                      deadline_snap["sites"].items())) or "-"))
+    if metrics_summary is not None and print_progress:
+        print("Metrics: %d snapshot(s) over %d series -> "
+              "metrics.jsonl, %d flight dump(s) from %d trigger(s); "
+              "SLO %d/%d within (peak burn %.3f)"
+              % (metrics_summary["snapshots"],
+                 metrics_summary["series"],
+                 metrics_summary["dumps"],
+                 metrics_summary["triggers"],
+                 metrics_summary["slo_within"],
+                 metrics_summary["slo_tracked"],
+                 metrics_summary["burn_max_milli"] / 1000.0))
     if hedge_stats is not None and print_progress:
         print("Hedge: %d fired, %d won by the hedge / %d by the "
               "original, %d ms of loser service wasted"
@@ -1182,6 +1314,22 @@ def run_benchmark(config_path: str,
         hedges_lost=hedge_stats["lost"] if hedge_stats else 0,
         hedges_wasted_ms=(hedge_stats["wasted_ms"]
                           if hedge_stats else 0),
+        metrics_snapshots=(metrics_summary["snapshots"]
+                           if metrics_summary else 0),
+        metrics_series=(metrics_summary["series"]
+                        if metrics_summary else 0),
+        metrics_dumps=(metrics_summary["dumps"]
+                       if metrics_summary else 0),
+        metrics_triggers=(metrics_summary["triggers"]
+                          if metrics_summary else 0),
+        slo_tracked=(metrics_summary["slo_tracked"]
+                     if metrics_summary else 0),
+        slo_within=(metrics_summary["slo_within"]
+                    if metrics_summary else 0),
+        slo_missed=(metrics_summary["slo_missed"]
+                    if metrics_summary else 0),
+        slo_burn_max_milli=(metrics_summary["burn_max_milli"]
+                            if metrics_summary else 0),
     )
 
 
@@ -1275,6 +1423,9 @@ def main(argv=None) -> int:
         print("trace: %s"
               % (json.dumps(cfg.trace, sort_keys=True)
                  if cfg.trace else "none"))
+        print("metrics: %s"
+              % (json.dumps(cfg.metrics, sort_keys=True)
+                 if cfg.metrics else "none"))
         hedged = {"step%d" % i: s.hedge_ms
                   for i, s in enumerate(cfg.steps)
                   if s.hedge_ms is not None}

@@ -38,7 +38,7 @@ ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
     "trace", "ragged", "handoff", "placement", "health", "deadline",
-    "_comment",
+    "metrics", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -66,6 +66,14 @@ HEALTH_KEYWORDS = ["enabled", "suspect_after_ms", "open_after_ms",
 
 #: keys a root 'deadline' object may carry (rnb_tpu.health)
 DEADLINE_KEYWORDS = ["enabled", "budget_ms"]
+
+#: keys a root 'metrics' object may carry (rnb_tpu.metrics)
+METRICS_KEYWORDS = ["enabled", "interval_ms", "flight_recorder"]
+
+#: keys a 'metrics.flight_recorder' object may carry
+FLIGHT_RECORDER_KEYWORDS = ["enabled", "ring_events", "max_dumps",
+                            "burn_threshold", "shed_spike_per_s",
+                            "queue_saturation", "cooldown_s"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -220,6 +228,13 @@ class PipelineConfig:
     #: expired requests (shed reason deadline_expired) instead of
     #: computing doomed work — rnb_tpu.health
     deadline: Optional[Dict[str, Any]] = None
+    #: validated live-metrics spec ({"enabled": .., "interval_ms": ..,
+    #: "flight_recorder": {..}}), or None; when enabled the launcher
+    #: builds an rnb_tpu.metrics.MetricsRegistry + background flusher
+    #: (metrics.jsonl / metrics.prom / flight-<n>.json in the job
+    #: dir) and log-meta gains the Metrics:/Slo: lines. Absent => no
+    #: registry, byte-stable logs.
+    metrics: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -618,6 +633,56 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 "(defaults to autotune.slo_ms when autotune is "
                 "configured), got %r" % (budget,))
 
+    metrics = raw.get("metrics")
+    if metrics is not None:
+        _expect(isinstance(metrics, dict), "'metrics' must be an object")
+        unknown_m = sorted(set(metrics) - set(METRICS_KEYWORDS))
+        _expect(not unknown_m,
+                "'metrics' has unknown key(s) %s — keys are %s"
+                % (unknown_m, METRICS_KEYWORDS))
+        _expect(isinstance(metrics.get("enabled", True), bool),
+                "'metrics.enabled' must be a boolean")
+        interval = metrics.get("interval_ms", 250.0)
+        _expect(isinstance(interval, (int, float))
+                and not isinstance(interval, bool) and interval > 0,
+                "'metrics.interval_ms' must be a positive number, "
+                "got %r" % (interval,))
+        fr = metrics.get("flight_recorder")
+        if fr is not None and not isinstance(fr, bool):
+            _expect(isinstance(fr, dict),
+                    "'metrics.flight_recorder' must be a boolean or "
+                    "an object")
+            unknown_fr = sorted(set(fr) - set(FLIGHT_RECORDER_KEYWORDS))
+            _expect(not unknown_fr,
+                    "'metrics.flight_recorder' has unknown key(s) %s "
+                    "— keys are %s" % (unknown_fr,
+                                       FLIGHT_RECORDER_KEYWORDS))
+            _expect(isinstance(fr.get("enabled", True), bool),
+                    "'metrics.flight_recorder.enabled' must be a "
+                    "boolean")
+            for key in ("ring_events", "max_dumps"):
+                val = fr.get(key)
+                _expect(val is None
+                        or (isinstance(val, int)
+                            and not isinstance(val, bool) and val >= 1),
+                        "'metrics.flight_recorder.%s' must be a "
+                        "positive integer, got %r" % (key, val))
+            for key in ("burn_threshold", "shed_spike_per_s",
+                        "cooldown_s"):
+                val = fr.get(key)
+                _expect(val is None
+                        or (isinstance(val, (int, float))
+                            and not isinstance(val, bool) and val > 0),
+                        "'metrics.flight_recorder.%s' must be a "
+                        "positive number, got %r" % (key, val))
+            sat = fr.get("queue_saturation")
+            _expect(sat is None
+                    or (isinstance(sat, (int, float))
+                        and not isinstance(sat, bool)
+                        and 0 < sat <= 1),
+                    "'metrics.flight_recorder.queue_saturation' must "
+                    "be a fraction in (0, 1], got %r" % (sat,))
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -827,4 +892,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           placement=placement,
                           health=health,
                           deadline=deadline,
+                          metrics=metrics,
                           trace=trace)

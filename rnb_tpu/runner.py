@@ -53,7 +53,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from rnb_tpu import hostprof, trace
+from rnb_tpu import hostprof, metrics, trace
 from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
                              FaultStats, InferenceCounter, Signal,
                              TerminationFlag, TerminationState,
@@ -706,6 +706,12 @@ def runner(ctx: RunnerContext) -> None:
             # sampled occupancy sources wire themselves up here; the
             # executor's own spans need no stage support
             model.enable_trace(ctx.tracer, ctx.step_idx)
+        # live-metrics plane (rnb_tpu.metrics): stage-owned subsystems
+        # (clip cache, staging pool, handoff edge) become poll sources
+        # of the active registry — registered before the start barrier
+        # so every flusher tick sees the full source set (no-op when
+        # metrics are off)
+        metrics.register_stage(model, handoff)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
@@ -1328,6 +1334,11 @@ def runner(ctx: RunnerContext) -> None:
                             time_card, TimeCardList) else [time_card]
                         for tc in cards:
                             summary.register(tc)
+                        # live SLO feed (rnb_tpu.metrics): the same
+                        # completions the summary registers stream
+                        # into the windowed goodput/burn gauges (one
+                        # None test when metrics are off)
+                        metrics.completions(cards)
                     if new >= ctx.num_videos:
                         if old < ctx.num_videos:
                             ctx.termination.raise_flag(

@@ -222,6 +222,18 @@ META_LINE_REGISTRY = (
               "trace-export counters: events written to trace.json, "
               "events dropped at the max_events cap "
               "(trace-enabled runs only)"),
+    StampSpec("Metrics:", "rnb_tpu/benchmark.py",
+              "live-metrics plane counters: interval snapshots "
+              "appended to metrics.jsonl, distinct series, flight-"
+              "recorder dumps written and triggers observed "
+              "(metrics-enabled runs only; --check holds the final "
+              "snapshot's counters to the Faults:/Cache:/Deadline:/"
+              "Hedge: ledgers exactly)"),
+    StampSpec("Slo:", "rnb_tpu/benchmark.py",
+              "live SLO-layer counters: completions tracked / within "
+              "deadline / missed, plus the run's peak burn rate in "
+              "milli-units (burn 1000 = consuming the error budget "
+              "exactly; metrics-enabled runs only)"),
     StampSpec("Phases:", "rnb_tpu/benchmark.py",
               "JSON per-phase latency attribution "
               "{phase: {mean_ms, p99_ms, count}} over steady-state "
@@ -321,6 +333,150 @@ TRACE_EVENT_REGISTRY = (
     StampSpec("queue.e{step}.depth", "rnb_tpu/benchmark.py",
               "counter (sampled): inter-stage queue depth, keyed by "
               "queue index"),
+)
+
+
+#: one declared live-metric series (rnb_tpu.metrics): ``pattern`` uses
+#: ``{step}`` like the other registries; ``kind`` is the series type
+#: (counter | gauge | rate | histogram); ``source`` says where samples
+#: come from — ``site`` (a ``metrics.counter/gauge/observe/mark/name``
+#: call site, which rnb-lint RNB-T009 requires to exist), ``bridge``
+#: (fed from same-named rnb_tpu.trace events through the SpanBridge —
+#: no metrics call site exists by design), ``poll`` (read from a
+#: subsystem's snapshot() each flusher tick) or ``derived`` (computed
+#: inside the registry, e.g. the SLO burn gauge).
+MetricSpec = namedtuple("MetricSpec",
+                        ("pattern", "kind", "source", "description"))
+
+#: every live-metric series name the tree may emit
+#: (``logs/<job>/metrics.jsonl`` + the Prometheus exposition file) —
+#: rnb-lint RNB-T009 cross-checks call sites against this, and the
+#: runtime registry rejects undeclared names outright
+METRIC_REGISTRY = (
+    # -- client (site-sourced) ----------------------------------------
+    MetricSpec("client.arrivals", "rate", "site",
+               "windowed request arrival rate at the client"),
+    MetricSpec("client.requests", "counter", "site",
+               "requests the client has created"),
+    MetricSpec("client.shed", "counter", "site",
+               "requests the client dropped at the full filename "
+               "queue"),
+    # -- executor hot loop (bridged from trace spans) -----------------
+    MetricSpec("exec{step}.queue_get", "histogram", "bridge",
+               "executor input-queue starvation wait (ms)"),
+    MetricSpec("exec{step}.hold_wait", "histogram", "bridge",
+               "executor batch-fill hold wait (ms)"),
+    MetricSpec("exec{step}.model_call", "histogram", "bridge",
+               "stage model-call service time (ms)"),
+    MetricSpec("exec{step}.device_sync", "histogram", "bridge",
+               "device output readiness wait (ms)"),
+    MetricSpec("exec{step}.publish", "histogram", "bridge",
+               "route + ring write + downstream enqueue (ms)"),
+    MetricSpec("loader.emit", "histogram", "bridge",
+               "fused-batch take/assemble/handoff (ms)"),
+    MetricSpec("loader.transfer", "histogram", "bridge",
+               "host->device transfer span (ms)"),
+    MetricSpec("staging.acquire_wait", "histogram", "bridge",
+               "staging-slot exhaustion backpressure wait (ms)"),
+    MetricSpec("batcher.emit", "counter", "bridge",
+               "Batcher fused emissions"),
+    MetricSpec("autotune.decision", "counter", "bridge",
+               "BatchController decisions"),
+    MetricSpec("health.lane_state", "counter", "bridge",
+               "lane health state transitions"),
+    # -- queue occupancy (probed each flusher tick) -------------------
+    MetricSpec("queue.filename.depth", "gauge", "site",
+               "client filename queue depth (saturation-armed)"),
+    MetricSpec("queue.e{step}.depth", "gauge", "site",
+               "inter-stage queue depth by edge ordinal "
+               "(saturation-armed)"),
+    # -- autotune controller (site-sourced gauges) --------------------
+    MetricSpec("autotune.arrival_hz", "gauge", "site",
+               "controller arrival-rate EWMA at the last decision"),
+    MetricSpec("autotune.target_rows", "gauge", "site",
+               "controller target row count at the last decision"),
+    # -- ledgers (polled from the shared stats objects) ---------------
+    MetricSpec("faults.num_failed", "counter", "poll",
+               "dead-lettered requests (FaultStats ledger)"),
+    MetricSpec("faults.num_shed", "counter", "poll",
+               "shed requests (FaultStats ledger)"),
+    MetricSpec("faults.num_retries", "counter", "poll",
+               "transient retry attempts (FaultStats ledger)"),
+    MetricSpec("faults.sheds", "rate", "site",
+               "windowed shed rate (shed-spike flight trigger)"),
+    MetricSpec("deadline.expired", "counter", "poll",
+               "requests shed as deadline_expired (DeadlineStats "
+               "ledger)"),
+    MetricSpec("hedge.fired", "counter", "poll",
+               "hedged re-dispatches fired (HedgeGovernor ledger)"),
+    MetricSpec("hedge.won", "counter", "poll",
+               "hedges the clone copy won"),
+    MetricSpec("hedge.lost", "counter", "poll",
+               "hedges the original copy won"),
+    MetricSpec("health.transitions", "counter", "poll",
+               "lane state-machine hops (LaneHealthBoard)"),
+    MetricSpec("health.opens", "counter", "poll",
+               "lane circuit opens"),
+    MetricSpec("health.evictions", "counter", "poll",
+               "permanently dead lanes"),
+    MetricSpec("health.probes", "counter", "poll",
+               "half-open recovery probes"),
+    MetricSpec("health.redispatches", "counter", "poll",
+               "items drained off evicted lanes onto siblings"),
+    # -- stage-owned subsystems (polled via metrics.register_stage) ---
+    MetricSpec("cache.hits", "counter", "poll",
+               "clip-cache lookup hits"),
+    MetricSpec("cache.misses", "counter", "poll",
+               "clip-cache lookup misses"),
+    MetricSpec("cache.inserts", "counter", "poll",
+               "clip-cache inserts"),
+    MetricSpec("cache.evictions", "counter", "poll",
+               "clip-cache LRU evictions"),
+    MetricSpec("cache.coalesced", "counter", "poll",
+               "requests that shared an in-flight decode"),
+    MetricSpec("cache.oversize", "counter", "poll",
+               "entries skipped as larger than the whole budget"),
+    MetricSpec("cache.bytes_resident", "gauge", "poll",
+               "resident cache bytes (shrinks on eviction)"),
+    MetricSpec("cache.entries", "gauge", "poll",
+               "resident cache entries"),
+    MetricSpec("staging.acquires", "counter", "poll",
+               "staging-slot acquires"),
+    MetricSpec("staging.acquire_waits", "counter", "poll",
+               "staging-slot exhaustion waits"),
+    MetricSpec("staging.staged_batches", "counter", "poll",
+               "zero-copy staged emissions"),
+    MetricSpec("staging.copied_batches", "counter", "poll",
+               "copy-fallback emissions"),
+    MetricSpec("staging.reallocs", "counter", "poll",
+               "alias-forced slot-buffer replacements"),
+    MetricSpec("staging.slots", "gauge", "poll",
+               "allocated staging slots"),
+    MetricSpec("handoff.d2d_edges", "counter", "poll",
+               "device-resident edge takes"),
+    MetricSpec("handoff.host_edges", "counter", "poll",
+               "host-round-trip edge takes"),
+    MetricSpec("handoff.d2d_bytes", "counter", "poll",
+               "bytes adopted/resharded on-device"),
+    MetricSpec("handoff.host_bytes", "counter", "poll",
+               "bytes moved through host memory"),
+    # -- the live SLO layer (derived inside the registry) -------------
+    MetricSpec("slo.good", "rate", "derived",
+               "windowed within-deadline completions"),
+    MetricSpec("slo.miss", "rate", "site",
+               "windowed SLO violations: late completions + "
+               "shed/failed requests"),
+    MetricSpec("slo.tracked", "counter", "derived",
+               "completions the SLO layer observed"),
+    MetricSpec("slo.within", "counter", "derived",
+               "completions inside their deadline/budget"),
+    MetricSpec("slo.missed", "counter", "derived",
+               "completions outside their deadline/budget"),
+    MetricSpec("slo.goodput_vps", "gauge", "derived",
+               "windowed within-deadline goodput (completions/s)"),
+    MetricSpec("slo.burn_rate", "gauge", "derived",
+               "windowed miss fraction / error budget (1.0 = "
+               "consuming the budget exactly)"),
 )
 
 

@@ -241,129 +241,145 @@ class Tracer:
         with self._lock:
             events = list(self._events)
             dropped = self.dropped
-        events.sort(key=lambda e: e[2])
-        t_base = events[0][2] if events else 0.0
-        tids: Dict[str, int] = {}
-        out: List[dict] = []
-        #: rid -> mutable [ts_us, tid, record] flow points
-        by_rid: Dict[int, List[list]] = {}
-        #: tid -> unrounded (start_us, end_us) of every duration slice
-        slice_ivals: Dict[int, List[Tuple[float, float]]] = {}
+        return export_events(events, dropped, path, job_id)
 
-        def tid_of(thread_name: str) -> int:
-            tid = tids.get(thread_name)
-            if tid is None:
-                tid = len(tids) + 1
-                tids[thread_name] = tid
-            return tid
 
-        for event_name, ph, t0, dur, thread_name, rid, args in events:
-            tid = tid_of(thread_name)
-            ts = (t0 - t_base) * 1e6
-            record = {"name": event_name, "ph": ph, "pid": 1,
-                      "tid": tid, "ts": round(ts, 3)}
-            if ph == "X":
-                dur_us = max(0.0, dur) * 1e6
-                record["dur"] = round(dur_us, 3)
-                slice_ivals.setdefault(tid, []).append(
-                    (ts, ts + dur_us))
-            record_args = dict(args) if args else {}
-            if rid is not None:
-                record_args["rid"] = rid
-                by_rid.setdefault(rid, []).append([ts, tid, record])
-            if record_args:
-                record["args"] = record_args
-            out.append(record)
+def export_events(events: List[Tuple], dropped: int, path: str,
+                  job_id: str = "",
+                  extra: Optional[dict] = None) -> int:
+    """Export one event list — ``(name, ph, t0, dur_s, thread_name,
+    rid, args)`` tuples, the :class:`Tracer` collection schema — as
+    Chrome-trace JSON. Shared by :meth:`Tracer.export` and the flight
+    recorder (rnb_tpu.metrics), whose bounded ring dumps MUST render
+    in Perfetto and pass :func:`validate_trace` exactly like a full
+    trace; ``extra`` keys land in ``otherData`` (the flight dump
+    carries its trigger + metric window there)."""
+    events = sorted(events, key=lambda e: e[2])
+    t_base = events[0][2] if events else 0.0
+    tids: Dict[str, int] = {}
+    out: List[dict] = []
+    #: rid -> mutable [ts_us, tid, record] flow points
+    by_rid: Dict[int, List[list]] = {}
+    #: tid -> unrounded (start_us, end_us) of every duration slice
+    slice_ivals: Dict[int, List[Tuple[float, float]]] = {}
 
-        # -- flow anchoring ------------------------------------------
-        # Perfetto/Chrome bind a legacy s/t/f flow event to the
-        # duration slice enclosing its ts on (pid, tid); an anchor
-        # outside every slice is silently dropped at import, which
-        # would amputate the chain ends living on instant-only tracks
-        # (client.enqueue, the swallow markers). Promote every
-        # unenclosed rid-instant to a thin anchor slice (<= 1 us,
-        # clamped so it cannot overlap the next slice or anchor on its
-        # track) and bind the flow at its midpoint.
-        starts_by_tid: Dict[int, List[float]] = {}
-        maxend_by_tid: Dict[int, List[float]] = {}
-        for tid, ivals in slice_ivals.items():
-            ivals.sort()
-            running, maxend = float("-inf"), []
-            for _start, end in ivals:
-                running = max(running, end)
-                maxend.append(running)
-            starts_by_tid[tid] = [start for start, _end in ivals]
-            maxend_by_tid[tid] = maxend
+    def tid_of(thread_name: str) -> int:
+        tid = tids.get(thread_name)
+        if tid is None:
+            tid = len(tids) + 1
+            tids[thread_name] = tid
+        return tid
 
-        def _enclosed(tid: int, ts: float) -> bool:
-            starts = starts_by_tid.get(tid)
-            if not starts:
-                return False
-            idx = bisect.bisect_right(starts, ts) - 1
-            return idx >= 0 and maxend_by_tid[tid][idx] > ts
+    for event_name, ph, t0, dur, thread_name, rid, args in events:
+        tid = tid_of(thread_name)
+        ts = (t0 - t_base) * 1e6
+        record = {"name": event_name, "ph": ph, "pid": 1,
+                  "tid": tid, "ts": round(ts, 3)}
+        if ph == "X":
+            dur_us = max(0.0, dur) * 1e6
+            record["dur"] = round(dur_us, 3)
+            slice_ivals.setdefault(tid, []).append(
+                (ts, ts + dur_us))
+        record_args = dict(args) if args else {}
+        if rid is not None:
+            record_args["rid"] = rid
+            by_rid.setdefault(rid, []).append([ts, tid, record])
+        if record_args:
+            record["args"] = record_args
+        out.append(record)
 
-        def _next_slice_start(tid: int, ts: float) -> Optional[float]:
-            starts = starts_by_tid.get(tid)
-            if not starts:
-                return None
-            idx = bisect.bisect_right(starts, ts)
-            return starts[idx] if idx < len(starts) else None
+    # -- flow anchoring ------------------------------------------
+    # Perfetto/Chrome bind a legacy s/t/f flow event to the
+    # duration slice enclosing its ts on (pid, tid); an anchor
+    # outside every slice is silently dropped at import, which
+    # would amputate the chain ends living on instant-only tracks
+    # (client.enqueue, the swallow markers). Promote every
+    # unenclosed rid-instant to a thin anchor slice (<= 1 us,
+    # clamped so it cannot overlap the next slice or anchor on its
+    # track) and bind the flow at its midpoint.
+    starts_by_tid: Dict[int, List[float]] = {}
+    maxend_by_tid: Dict[int, List[float]] = {}
+    for tid, ivals in slice_ivals.items():
+        ivals.sort()
+        running, maxend = float("-inf"), []
+        for _start, end in ivals:
+            running = max(running, end)
+            maxend.append(running)
+        starts_by_tid[tid] = [start for start, _end in ivals]
+        maxend_by_tid[tid] = maxend
 
-        all_points = sorted((p for pts in by_rid.values() for p in pts),
-                            key=lambda p: (p[1], p[0]))
-        last_anchor: Dict[int, Tuple[float, float, dict, list]] = {}
-        for point in all_points:
-            ts, tid, record = point
-            if record["ph"] != "i" or _enclosed(tid, ts):
-                continue
-            nxt = _next_slice_start(tid, ts)
-            dur = 1.0 if nxt is None else min(1.0, nxt - ts)
-            prev = last_anchor.get(tid)
-            if prev is not None and ts < prev[0] + prev[1]:
-                # shrink the previous anchor up to this one's start
-                p_ts, _p_dur, p_record, p_point = prev
-                p_dur = max(0.0, ts - p_ts)
-                p_record["dur"] = round(p_dur, 3)
-                p_point[0] = p_ts + p_dur / 2.0
-            record["ph"] = "X"
-            record["dur"] = round(dur, 3)
-            point[0] = ts + dur / 2.0
-            last_anchor[tid] = (ts, dur, record, point)
+    def _enclosed(tid: int, ts: float) -> bool:
+        starts = starts_by_tid.get(tid)
+        if not starts:
+            return False
+        idx = bisect.bisect_right(starts, ts) - 1
+        return idx >= 0 and maxend_by_tid[tid][idx] > ts
 
-        # flow chains: every rid with >= 2 correlated events gets a
-        # start -> step... -> finish chain binding its spans across
-        # thread tracks (Perfetto draws the arrows)
-        num_flows = 0
-        for rid in sorted(by_rid):
-            points = sorted(by_rid[rid], key=lambda p: (p[0], p[1]))
-            if len(points) < 2:
-                continue
-            num_flows += 1
-            last = len(points) - 1
-            for idx, (ts, tid, record) in enumerate(points):
-                ph = "s" if idx == 0 else ("f" if idx == last else "t")
-                flow = {"name": "request", "cat": "request", "ph": ph,
-                        "id": rid, "pid": 1, "tid": tid,
-                        "ts": round(ts, 3)}
-                if ph == "f":
-                    flow["bp"] = "e"
-                out.append(flow)
-        meta = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
-                 "ts": 0, "args": {"name": "rnb-tpu %s" % job_id}}]
-        for thread_name, tid in sorted(tids.items(), key=lambda kv: kv[1]):
-            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
-                         "tid": tid, "ts": 0,
-                         "args": {"name": thread_name}})
-        doc = {"traceEvents": meta + out,
-               "displayTimeUnit": "ms",
-               "otherData": {"job_id": job_id,
-                             "num_events": len(events),
-                             "num_flows": num_flows,
-                             "dropped_events": dropped,
-                             "t_base_epoch_s": t_base}}
-        with open(path, "w") as f:
-            json.dump(doc, f)
-        return len(events)
+    def _next_slice_start(tid: int, ts: float) -> Optional[float]:
+        starts = starts_by_tid.get(tid)
+        if not starts:
+            return None
+        idx = bisect.bisect_right(starts, ts)
+        return starts[idx] if idx < len(starts) else None
+
+    all_points = sorted((p for pts in by_rid.values() for p in pts),
+                        key=lambda p: (p[1], p[0]))
+    last_anchor: Dict[int, Tuple[float, float, dict, list]] = {}
+    for point in all_points:
+        ts, tid, record = point
+        if record["ph"] != "i" or _enclosed(tid, ts):
+            continue
+        nxt = _next_slice_start(tid, ts)
+        dur = 1.0 if nxt is None else min(1.0, nxt - ts)
+        prev = last_anchor.get(tid)
+        if prev is not None and ts < prev[0] + prev[1]:
+            # shrink the previous anchor up to this one's start
+            p_ts, _p_dur, p_record, p_point = prev
+            p_dur = max(0.0, ts - p_ts)
+            p_record["dur"] = round(p_dur, 3)
+            p_point[0] = p_ts + p_dur / 2.0
+        record["ph"] = "X"
+        record["dur"] = round(dur, 3)
+        point[0] = ts + dur / 2.0
+        last_anchor[tid] = (ts, dur, record, point)
+
+    # flow chains: every rid with >= 2 correlated events gets a
+    # start -> step... -> finish chain binding its spans across
+    # thread tracks (Perfetto draws the arrows)
+    num_flows = 0
+    for rid in sorted(by_rid):
+        points = sorted(by_rid[rid], key=lambda p: (p[0], p[1]))
+        if len(points) < 2:
+            continue
+        num_flows += 1
+        last = len(points) - 1
+        for idx, (ts, tid, record) in enumerate(points):
+            ph = "s" if idx == 0 else ("f" if idx == last else "t")
+            flow = {"name": "request", "cat": "request", "ph": ph,
+                    "id": rid, "pid": 1, "tid": tid,
+                    "ts": round(ts, 3)}
+            if ph == "f":
+                flow["bp"] = "e"
+            out.append(flow)
+    meta = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "ts": 0, "args": {"name": "rnb-tpu %s" % job_id}}]
+    for thread_name, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                     "tid": tid, "ts": 0,
+                     "args": {"name": thread_name}})
+    other = {"job_id": job_id,
+             "num_events": len(events),
+             "num_flows": num_flows,
+             "dropped_events": dropped,
+             "t_base_epoch_s": t_base}
+    if extra:
+        other.update(extra)
+    doc = {"traceEvents": meta + out,
+           "displayTimeUnit": "ms",
+           "otherData": other}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(events)
 
 
 def validate_trace(path: str) -> List[str]:

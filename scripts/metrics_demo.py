@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""``make metrics``: the live-metrics plane, asserted end-to-end.
+
+Two arms, both through ``run_benchmark`` on the 8-virtual-device CPU
+backend (no dataset, no native decoder):
+
+* **Live arm** — a tiny 2-stage pipeline with the root ``metrics`` key
+  enabled plus a ``deadline`` budget (so the SLO layer has a real
+  contract) and a forced flight dump (``RNB_FLIGHT_FORCE``). Asserts:
+  >= 3 interval snapshots landed in ``metrics.jsonl``; the FINAL
+  snapshot's counters cross-foot the BenchmarkResult ledgers exactly
+  (metrics are checked, not trusted); the flight dump is loadable per
+  ``rnb_tpu.trace.validate_trace``; the Prometheus exposition file
+  exists; and ``parse_utils --check`` is green including the new
+  metrics invariants (monotone counters, histogram bucket sums,
+  footing, dump validity).
+* **Chaos arm** — the SHIPPED replica-loss arm
+  (configs/rnb-scaleout-r4-chaos.json) with the ``metrics`` key added
+  in a temp copy: the seeded lane-3 wedge walks the circuit to OPEN
+  mid-stream, which must fire the flight recorder's circuit-open
+  trigger — a ``flight-<n>.json`` whose ``otherData.flight_trigger``
+  is ``circuit_open``, structurally valid, with the metric window
+  embedded. ``--check`` green here too.
+
+Exit 0 = the live plane streams, foots, and black-boxes incidents.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LIVE_CONFIG = {
+    "_comment": "make-metrics demo: tiny 2-stage pipeline, live "
+                "metrics + deadline SLO on",
+    "video_path_iterator":
+        "tests.pipeline_helpers.CountingPathIterator",
+    "metrics": {"enabled": True, "interval_ms": 20},
+    "deadline": {"budget_ms": 500},
+    "pipeline": [
+        {"model": "tests.pipeline_helpers.TinyLoader",
+         "queue_groups": [{"devices": [0], "out_queues": [0]}],
+         "num_shared_tensors": 4},
+        {"model": "tests.pipeline_helpers.TinySink",
+         "queue_groups": [{"devices": [1], "in_queue": 0}]},
+    ],
+}
+
+CHAOS_CONFIG = "configs/rnb-scaleout-r4-chaos.json"
+CHAOS_VIDEOS = 12
+
+
+def _flight_dumps(log_dir):
+    return sorted(name for name in os.listdir(log_dir)
+                  if name.startswith("flight-")
+                  and name.endswith(".json"))
+
+
+def _check(parse_utils, log_dir, failures, arm):
+    problems, parse_failed = parse_utils.check_job_detail(log_dir)
+    for problem in problems:
+        failures.append("%s --check (%s): %s"
+                        % (arm, "parse" if parse_failed
+                           else "invariant", problem))
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.trace import validate_trace
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    failures = []
+
+    # -- live arm -----------------------------------------------------
+    with tempfile.TemporaryDirectory(prefix="rnb-metrics-") as tmp:
+        cfg_path = os.path.join(tmp, "metrics-demo.json")
+        with open(cfg_path, "w") as f:
+            json.dump(LIVE_CONFIG, f)
+        os.environ["RNB_FLIGHT_FORCE"] = "1"
+        try:
+            res = run_benchmark(cfg_path, mean_interval_ms=1,
+                                num_videos=120, queue_size=50,
+                                log_base=os.path.join(tmp, "logs"),
+                                print_progress=False)
+        finally:
+            del os.environ["RNB_FLIGHT_FORCE"]
+        if res.termination_flag != 0:
+            failures.append("live arm terminated with flag %d"
+                            % res.termination_flag)
+        print("live arm: %d snapshot(s) over %d series, %d flight "
+              "dump(s); SLO %d/%d within (peak burn %.3f)"
+              % (res.metrics_snapshots, res.metrics_series,
+                 res.metrics_dumps, res.slo_within, res.slo_tracked,
+                 res.slo_burn_max_milli / 1000.0))
+        if res.metrics_snapshots < 3:
+            failures.append("live arm produced only %d snapshot(s) "
+                            "(need >= 3 — the flusher must stream, "
+                            "not summarize at exit)"
+                            % res.metrics_snapshots)
+        snapshots = parse_utils.load_metrics(res.log_dir)
+        if len(snapshots) != res.metrics_snapshots:
+            failures.append("metrics.jsonl holds %d snapshot(s) but "
+                            "the result says %d"
+                            % (len(snapshots), res.metrics_snapshots))
+        final = dict(snapshots[-1].get("counters", {})) \
+            if snapshots else {}
+        for counter_name, want in (
+                ("faults.num_failed", res.num_failed),
+                ("faults.num_shed", res.num_shed),
+                ("deadline.expired", res.deadline_expired),
+                ("slo.tracked", res.slo_tracked),
+                ("slo.within", res.slo_within)):
+            if final.get(counter_name) != want:
+                failures.append(
+                    "final snapshot %s=%s does not foot the "
+                    "BenchmarkResult value %s"
+                    % (counter_name, final.get(counter_name), want))
+        # >=, not ==: the open-loop poisson client may legally create
+        # one request past the target before observing termination
+        if final.get("client.requests", 0) < 120:
+            failures.append(
+                "final snapshot client.requests=%s below the %d "
+                "requests the client must have created"
+                % (final.get("client.requests"), 120))
+        dumps = _flight_dumps(res.log_dir)
+        if len(dumps) != 1:
+            failures.append("expected exactly 1 forced flight dump, "
+                            "got %s" % dumps)
+        for name in dumps:
+            path = os.path.join(res.log_dir, name)
+            for issue in validate_trace(path):
+                failures.append("%s: %s" % (name, issue))
+            doc = json.load(open(path))
+            if doc["otherData"].get("flight_trigger") != "forced":
+                failures.append("%s: trigger %r, expected 'forced'"
+                                % (name,
+                                   doc["otherData"]
+                                   .get("flight_trigger")))
+        if not os.path.isfile(os.path.join(res.log_dir,
+                                           "metrics.prom")):
+            failures.append("live arm wrote no metrics.prom")
+        _check(parse_utils, res.log_dir, failures, "live arm")
+
+        # -- chaos arm ------------------------------------------------
+        with open(os.path.join(REPO, CHAOS_CONFIG)) as f:
+            chaos_raw = json.load(f)
+        chaos_raw["metrics"] = {"enabled": True, "interval_ms": 100}
+        chaos_path = os.path.join(tmp, "chaos-metrics.json")
+        with open(chaos_path, "w") as f:
+            json.dump(chaos_raw, f)
+        res = run_benchmark(chaos_path, mean_interval_ms=0,
+                            num_videos=CHAOS_VIDEOS, queue_size=64,
+                            log_base=os.path.join(tmp, "chaos-logs"),
+                            print_progress=False, seed=17)
+        if res.termination_flag != 0:
+            failures.append("chaos arm terminated with flag %d"
+                            % res.termination_flag)
+        dumps = _flight_dumps(res.log_dir)
+        triggers = {}
+        for name in dumps:
+            path = os.path.join(res.log_dir, name)
+            for issue in validate_trace(path):
+                failures.append("chaos %s: %s" % (name, issue))
+            doc = json.load(open(path))
+            triggers[name] = doc["otherData"].get("flight_trigger")
+            if not doc["otherData"].get("metric_window"):
+                failures.append("chaos %s embeds no metric window"
+                                % name)
+        print("chaos arm: circuit opens=%d, %d flight dump(s): %s"
+              % (res.health_opens, len(dumps),
+                 json.dumps(triggers, sort_keys=True)))
+        if res.health_opens < 1:
+            failures.append("the chaos wedge never opened the "
+                            "circuit (opens=0)")
+        if "circuit_open" not in triggers.values():
+            failures.append(
+                "the lane kill produced no circuit-open flight dump "
+                "(dumps: %s) — the black-box recorder missed exactly "
+                "the incident it exists for"
+                % json.dumps(triggers, sort_keys=True))
+        _check(parse_utils, res.log_dir, failures, "chaos arm")
+
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("OK — live metrics stream, the final snapshot foots the "
+          "ledgers, and the lane kill left a circuit-open flight "
+          "dump loadable in Perfetto")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,21 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["trace_" + key] = int(val)
+        elif line.startswith("Metrics:"):
+            # "Metrics: snapshots=S series=K dumps=D triggers=T" —
+            # live-metrics plane accounting (rnb_tpu.metrics), written
+            # only by metrics-enabled runs; --check cross-foots the
+            # final metrics.jsonl snapshot against the ledger lines
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["metrics_" + key] = int(val)
+        elif line.startswith("Slo:"):
+            # "Slo: tracked=T within=W missed=M burn_max_milli=B" —
+            # the live SLO layer's final ledger (rnb_tpu.metrics),
+            # metrics-enabled runs only
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["slo_" + key] = int(val)
         elif line.startswith("Phases:"):
             # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
             # latency attribution over steady-state completions,
@@ -838,6 +853,11 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # trace.json actually holds, and the artifact must be structurally
     # valid (every event stamped, every flow resolving)
     problems.extend(_check_trace_artifact(job_dir, meta))
+    # live-metrics plane (rnb_tpu.metrics): counters monotone across
+    # snapshots, histogram bucket sums equal to counts, the FINAL
+    # snapshot footing the Faults:/Cache:/Deadline:/Hedge:/Slo:
+    # ledgers exactly, and every flight dump structurally valid
+    problems.extend(_check_metrics(job_dir, meta))
     return problems, parse_failed
 
 
@@ -1252,6 +1272,167 @@ def _check_trace_artifact(job_dir: str,
     return problems
 
 
+def load_metrics(job_dir: str) -> List[Dict[str, object]]:
+    """One job's ``metrics.jsonl`` -> list of snapshot dicts (empty
+    when the file is absent — metrics-off runs write nothing)."""
+    import json
+    path = os.path.join(job_dir, "metrics.jsonl")
+    if not os.path.isfile(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+#: (final-snapshot counter name, log-meta key) pairs the metrics
+#: footing check holds equal whenever the meta key is present — the
+#: "metrics are checked, not trusted" rule: the live plane must agree
+#: with the end-of-run ledgers EXACTLY at the final snapshot
+_METRICS_FOOTING = (
+    ("faults.num_failed", "num_failed"),
+    ("faults.num_shed", "num_shed"),
+    ("faults.num_retries", "num_retries"),
+    ("cache.hits", "cache_hits"),
+    ("cache.misses", "cache_misses"),
+    ("cache.inserts", "cache_inserts"),
+    ("cache.evictions", "cache_evictions"),
+    ("cache.coalesced", "cache_coalesced"),
+    ("cache.oversize", "cache_oversize"),
+    ("staging.acquires", "staging_acquires"),
+    ("staging.acquire_waits", "staging_acquire_waits"),
+    ("staging.staged_batches", "staging_staged_batches"),
+    ("staging.copied_batches", "staging_copied_batches"),
+    ("staging.reallocs", "staging_reallocs"),
+    ("deadline.expired", "deadline_expired"),
+    ("hedge.fired", "hedges_fired"),
+    ("hedge.won", "hedges_won"),
+    ("hedge.lost", "hedges_lost"),
+    ("health.transitions", "health_transitions"),
+    ("health.opens", "health_opens"),
+    ("health.evictions", "health_evictions"),
+    ("health.probes", "health_probes"),
+    ("health.redispatches", "health_redispatches"),
+    ("handoff.d2d_edges", "handoff_d2d_edges"),
+    ("handoff.host_edges", "handoff_host_edges"),
+    ("handoff.d2d_bytes", "handoff_d2d_bytes"),
+    ("handoff.host_bytes", "handoff_host_bytes"),
+    ("slo.tracked", "slo_tracked"),
+    ("slo.within", "slo_within"),
+    ("slo.missed", "slo_missed"),
+)
+
+
+def _check_metrics(job_dir: str,
+                   meta: Dict[str, object]) -> List[str]:
+    """Live-metrics invariants (rnb_tpu.metrics): see
+    :data:`_METRICS_FOOTING` plus snapshot monotonicity, histogram
+    internal consistency, and flight-dump validity."""
+    problems: List[str] = []
+    jsonl = os.path.join(job_dir, "metrics.jsonl")
+    flights = sorted(
+        name_ for name_ in os.listdir(job_dir)
+        if re.fullmatch(r"flight-\d+\.json", name_))
+    if "metrics_snapshots" not in meta:
+        if os.path.isfile(jsonl):
+            problems.append("metrics.jsonl present but log-meta has "
+                            "no 'Metrics:' line")
+        if flights:
+            problems.append("flight dump(s) %s present but log-meta "
+                            "has no 'Metrics:' line" % flights)
+        return problems
+    snapshots = load_metrics(job_dir)
+    if not snapshots:
+        return ["log-meta carries a 'Metrics:' line but "
+                "metrics.jsonl is missing or empty"]
+    if len(snapshots) != meta["metrics_snapshots"]:
+        problems.append(
+            "'Metrics:' line says snapshots=%s but metrics.jsonl "
+            "holds %d" % (meta["metrics_snapshots"], len(snapshots)))
+    if "slo_tracked" not in meta:
+        problems.append("log-meta carries a 'Metrics:' line but no "
+                        "'Slo:' line (the two ship together)")
+    last_seq = 0
+    prev_counters: Dict[str, object] = {}
+    for idx, snap in enumerate(snapshots):
+        seq = int(snap.get("seq", 0))
+        if seq <= last_seq:
+            problems.append(
+                "metrics.jsonl snapshot %d: seq %d is not increasing "
+                "(previous %d)" % (idx, seq, last_seq))
+        last_seq = seq
+        counters = dict(snap.get("counters", {}))
+        for key, value in counters.items():
+            if int(value) < int(prev_counters.get(key, 0)):
+                problems.append(
+                    "metrics.jsonl snapshot %d: counter %r decreased "
+                    "%s -> %s (counters must be monotone)"
+                    % (idx, key, prev_counters.get(key), value))
+        prev_counters = counters
+        for hname, hist in dict(snap.get("histograms", {})).items():
+            hist = dict(hist)
+            bucket_sum = sum(int(b) for b in hist.get("buckets", []))
+            if bucket_sum != int(hist.get("count", -1)):
+                problems.append(
+                    "metrics.jsonl snapshot %d: histogram %r bucket "
+                    "sum %d != count %s" % (idx, hname, bucket_sum,
+                                            hist.get("count")))
+    final = dict(snapshots[-1].get("counters", {}))
+    for counter_name, meta_key in _METRICS_FOOTING:
+        if meta_key not in meta:
+            continue
+        if counter_name not in final:
+            problems.append(
+                "final metrics snapshot is missing %r (log-meta "
+                "carries %s=%s)" % (counter_name, meta_key,
+                                    meta[meta_key]))
+        elif int(final[counter_name]) != int(meta[meta_key]):
+            problems.append(
+                "final metrics snapshot %s=%s does not foot log-meta "
+                "%s=%s (metrics are checked, not trusted)"
+                % (counter_name, final[counter_name], meta_key,
+                   meta[meta_key]))
+    if len(flights) != meta.get("metrics_dumps", 0):
+        problems.append(
+            "'Metrics:' line says dumps=%s but the job dir holds %d "
+            "flight dump(s): %s" % (meta.get("metrics_dumps"),
+                                    len(flights), flights))
+    if meta.get("metrics_dumps", 0) > meta.get("metrics_triggers", 0):
+        problems.append(
+            "metrics_dumps=%s exceeds metrics_triggers=%s (every "
+            "dump needs a trigger)" % (meta.get("metrics_dumps"),
+                                       meta.get("metrics_triggers")))
+    trace = _rnb_trace()
+    import json
+    for name_ in flights:
+        path = os.path.join(job_dir, name_)
+        for issue in trace.validate_trace(path)[:3]:
+            problems.append("%s: %s" % (name_, issue))
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError:
+            continue  # validate_trace already reported it
+        if not doc.get("otherData", {}).get("flight_trigger"):
+            problems.append("%s: otherData names no flight_trigger"
+                            % name_)
+    if not os.path.isfile(os.path.join(job_dir, "metrics.prom")):
+        problems.append("metrics-enabled run wrote no metrics.prom "
+                        "exposition file")
+    # the Slo: ledger must partition: within + missed == tracked
+    if "slo_tracked" in meta \
+            and meta.get("slo_within", 0) + meta.get("slo_missed", 0) \
+            != meta["slo_tracked"]:
+        problems.append(
+            "slo_within=%s + slo_missed=%s != slo_tracked=%s (every "
+            "tracked completion has exactly one verdict)"
+            % (meta.get("slo_within"), meta.get("slo_missed"),
+               meta["slo_tracked"]))
+    return problems
+
+
 def _configured_buckets(job_dir: str) -> set:
     """Every row count the job's config could legally warm: the union
     of ``row_buckets`` / ``max_clips`` / ``max_rows`` values across
@@ -1307,7 +1488,8 @@ def print_stamp_registry(out=None) -> None:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in _sys.path:
         _sys.path.insert(0, repo)
-    from rnb_tpu.telemetry import (META_LINE_REGISTRY, STAMP_REGISTRY,
+    from rnb_tpu.telemetry import (META_LINE_REGISTRY, METRIC_REGISTRY,
+                                   STAMP_REGISTRY,
                                    TABLE_TRAILER_REGISTRY,
                                    TRACE_EVENT_REGISTRY, CONTENT_STAMPS)
     out.write("# Telemetry schema reference (generated by "
@@ -1337,6 +1519,13 @@ def print_stamp_registry(out=None) -> None:
     for spec in TRACE_EVENT_REGISTRY:
         out.write("%-26s %-22s %s\n" % (spec.pattern, spec.producer,
                                         spec.description))
+    out.write("\n## Live-metric series (logs/<job>/metrics.jsonl + "
+              "metrics.prom,\n## metrics-enabled runs only; kind/"
+              "source per rnb_tpu.telemetry.MetricSpec)\n")
+    for spec in METRIC_REGISTRY:
+        out.write("%-26s %-10s %-7s %s\n"
+                  % (spec.pattern, spec.kind, spec.source,
+                     spec.description))
 
 
 def main(argv=None) -> int:

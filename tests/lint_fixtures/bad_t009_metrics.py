@@ -1,0 +1,13 @@
+"""RNB-T009: emits an unregistered metric series name (plus the
+registered ones, so no dead-registry finding muddies the fixture)."""
+
+from rnb_tpu import metrics
+
+
+def emit(step, value, ms):
+    metrics.counter("good.requests")
+    metrics.gauge("good.depth", value)
+    metrics.observe("good.latency", ms)
+    metrics.mark("good.arrivals")
+    metrics.gauge(metrics.name("good.e%d.depth", step), value)
+    metrics.counter("mystery.series")

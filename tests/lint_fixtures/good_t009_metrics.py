@@ -1,0 +1,13 @@
+"""Schema fixture: emits exactly the (test-local) registered metric
+series names through every rnb_tpu.metrics entry-point shape the
+extractor must see."""
+
+from rnb_tpu import metrics
+
+
+def emit(step, value, ms):
+    metrics.counter("good.requests")
+    metrics.gauge("good.depth", value)
+    metrics.observe("good.latency", ms)
+    metrics.mark("good.arrivals")
+    metrics.gauge(metrics.name("good.e%d.depth", step), value)

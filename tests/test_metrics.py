@@ -1,0 +1,618 @@
+"""Live metrics plane (rnb_tpu.metrics): registry semantics, flusher,
+SLO burn-rate math, flight recorder, config validation, disabled-path
+no-ops, and the metrics-off byte-stability contract.
+
+Unit coverage runs without JAX; the e2e cases drive the tiny test
+pipeline (tests.pipeline_helpers) through run_benchmark with the root
+``metrics`` config key on and off.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from rnb_tpu import metrics, trace
+from rnb_tpu.metrics import (MetricsRegistry, MetricsSettings,
+                             SpanBridge, hist_bucket,
+                             hist_upper_bounds)
+from rnb_tpu.telemetry import TimeCard
+from rnb_tpu.trace import Tracer, TraceSettings, validate_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_active_registry():
+    """Unit tests must never leak a module-global registry/tracer into
+    later tests (benchmark.py owns install/clear in real runs)."""
+    metrics.ACTIVE = None
+    trace.ACTIVE = None
+    yield
+    metrics.ACTIVE = None
+    trace.ACTIVE = None
+
+
+# -- settings / config validation -------------------------------------
+
+def test_settings_from_config():
+    assert MetricsSettings.from_config(None) is None
+    assert MetricsSettings.from_config({"enabled": False}) is None
+    s = MetricsSettings.from_config({})
+    assert s is not None
+    assert s.interval_ms == metrics.DEFAULT_INTERVAL_MS
+    assert s.flight_enabled
+    assert s.ring_events == metrics.DEFAULT_RING_EVENTS
+    s = MetricsSettings.from_config(
+        {"interval_ms": 25,
+         "flight_recorder": {"enabled": False}})
+    assert s.interval_ms == 25.0 and not s.flight_enabled
+    s = MetricsSettings.from_config(
+        {"flight_recorder": {"ring_events": 16, "max_dumps": 2,
+                             "burn_threshold": 1.5}})
+    assert s.ring_events == 16 and s.max_dumps == 2
+    assert s.burn_threshold == 1.5
+
+
+def _cfg(metrics_value, extra=None):
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "metrics": metrics_value,
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 4},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [1], "in_queue": 0}]},
+        ],
+    }
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def test_config_accepts_valid_metrics_key():
+    from rnb_tpu.config import parse_config
+    cfg = parse_config(_cfg({"enabled": True, "interval_ms": 50,
+                             "flight_recorder": {"ring_events": 256}}))
+    assert cfg.metrics == {"enabled": True, "interval_ms": 50,
+                           "flight_recorder": {"ring_events": 256}}
+    # boolean shorthand for the recorder
+    parse_config(_cfg({"flight_recorder": False}))
+
+
+@pytest.mark.parametrize("bad", [
+    "yes",                                  # not an object
+    {"enable": True},                       # unknown key
+    {"enabled": 1},                         # non-bool enabled
+    {"interval_ms": 0},                     # non-positive interval
+    {"interval_ms": True},                  # bool as number
+    {"flight_recorder": 3},                 # recorder not bool/object
+    {"flight_recorder": {"rings": 4}},      # unknown recorder key
+    {"flight_recorder": {"ring_events": 0}},
+    {"flight_recorder": {"max_dumps": 1.5}},
+    {"flight_recorder": {"burn_threshold": 0}},
+    {"flight_recorder": {"queue_saturation": 1.5}},
+])
+def test_config_rejects_bad_metrics_key(bad):
+    from rnb_tpu.config import ConfigError, parse_config
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(bad))
+
+
+# -- disabled-path no-ops ---------------------------------------------
+
+def test_disabled_module_hooks_are_noops():
+    metrics.counter("client.requests")
+    metrics.gauge("queue.filename.depth", 3)
+    metrics.observe("exec0.model_call", 1.5)
+    metrics.mark("client.arrivals")
+    metrics.trigger("circuit_open")
+    metrics.completions([TimeCard(1)])
+    metrics.register_stage(object())
+
+
+# -- registry semantics -----------------------------------------------
+
+def test_counter_gauge_rate_histogram_semantics():
+    reg = MetricsRegistry(MetricsSettings())
+    reg.inc_counter("client.requests", 2)
+    reg.inc_counter("client.requests")
+    reg.set_gauge("queue.filename.depth", 7)
+    reg.set_gauge("queue.filename.depth", 4)
+    reg.mark_rate("client.arrivals", 5, now=1000.0)
+    reg.observe_ms("exec0.model_call", 3.0)
+    reg.observe_ms("exec0.model_call", 100.0)
+    snap = reg.snapshot(now=1000.5)
+    assert snap["counters"]["client.requests"] == 3
+    assert snap["gauges"]["queue.filename.depth"] == 4.0
+    assert snap["rates"]["client.arrivals"] == pytest.approx(
+        5 / metrics.RATE_WINDOW_S)
+    hist = snap["histograms"]["exec0.model_call"]
+    assert hist["count"] == 2 and sum(hist["buckets"]) == 2
+    assert hist["sum_ms"] == pytest.approx(103.0)
+
+
+def test_undeclared_metric_name_raises():
+    reg = MetricsRegistry(MetricsSettings())
+    with pytest.raises(ValueError, match="not declared"):
+        reg.inc_counter("mystery.series")
+    with pytest.raises(ValueError, match="not declared"):
+        reg.set_gauge("mystery.series", 1.0)
+
+
+def test_histogram_bucket_placement_and_bounds():
+    bounds = hist_upper_bounds()
+    assert len(bounds) == metrics.HIST_NUM_BUCKETS
+    assert bounds[0] == 2.0 ** metrics.HIST_LOG2_MIN
+    assert bounds[-1] == float("inf")
+    # everything at or below the first bound lands in bucket 0
+    assert hist_bucket(0.0) == 0
+    assert hist_bucket(0.125) == 0
+    # each observation lands in the first bucket whose bound covers it
+    for ms in (0.2, 1.0, 7.0, 500.0, 1e9):
+        b = hist_bucket(ms)
+        assert ms <= bounds[b]
+        if b > 0:
+            assert ms > bounds[b - 1]
+
+
+def test_rate_window_prunes_and_bounds_memory():
+    reg = MetricsRegistry(MetricsSettings())
+    for sec in range(100):
+        reg.mark_rate("client.arrivals", 1, now=1000.0 + sec)
+    rate = reg._rates["client.arrivals"]
+    # bounded: only cells inside the window survive
+    assert len(rate.cells) <= metrics.RATE_WINDOW_S + 1
+    # 11 one-per-second cells survive (closed interval fencepost)
+    assert rate.per_second(1099.0) == pytest.approx(
+        11 / metrics.RATE_WINDOW_S)
+    # far in the future the window is empty but lifetime total holds
+    assert rate.per_second(5000.0) == 0.0
+    assert rate.total == 100
+
+
+def test_series_cardinality_is_bounded():
+    reg = MetricsRegistry(MetricsSettings())
+    for idx in range(metrics.MAX_SERIES + 50):
+        reg.set_gauge("queue.e%d.depth" % idx, 1.0)
+    assert len(reg._gauges) == metrics.MAX_SERIES
+    snap = reg.snapshot(now=1.0)
+    assert snap["series_overflowed"] >= 50
+
+
+def test_counters_monotone_across_snapshots():
+    reg = MetricsRegistry(MetricsSettings())
+    values = []
+    for step in range(4):
+        reg.inc_counter("client.requests", step + 1)
+        values.append(
+            reg.snapshot(now=float(step))["counters"]
+            ["client.requests"])
+    assert values == sorted(values)
+
+
+# -- poll sources -----------------------------------------------------
+
+def test_poll_sources_sum_across_instances():
+    reg = MetricsRegistry(MetricsSettings())
+    a = {"hits": 3, "misses": 1}
+    b = {"hits": 2, "misses": 5}
+    reg.add_poll(metrics.snapshot_poll("cache", lambda: a,
+                                       counters=("hits", "misses")))
+    reg.add_poll(metrics.snapshot_poll("cache", lambda: b,
+                                       counters=("hits", "misses")))
+    snap = reg.snapshot(now=1.0)
+    assert snap["counters"]["cache.hits"] == 5
+    assert snap["counters"]["cache.misses"] == 6
+    a["hits"] = 10  # sources advance; the polled sum follows
+    assert reg.snapshot(now=2.0)["counters"]["cache.hits"] == 12
+
+
+def test_register_stage_bridges_cache_and_staging():
+    class FakeCache:
+        def snapshot(self):
+            return {"hits": 4, "misses": 2, "inserts": 2,
+                    "evictions": 0, "coalesced": 1, "oversize": 0,
+                    "bytes_resident": 128, "entries": 2}
+
+    class FakeStaging:
+        def snapshot(self):
+            return {"slots": 3, "acquires": 9, "acquire_waits": 1,
+                    "staged_batches": 7, "copied_batches": 2,
+                    "reallocs": 0}
+
+    class FakeModel:
+        cache = FakeCache()
+        staging = FakeStaging()
+
+    reg = MetricsRegistry(MetricsSettings())
+    metrics.ACTIVE = reg
+    metrics.register_stage(FakeModel())
+    snap = reg.snapshot(now=1.0)
+    assert snap["counters"]["cache.hits"] == 4
+    assert snap["counters"]["staging.staged_batches"] == 7
+    assert snap["gauges"]["cache.bytes_resident"] == 128.0
+    assert snap["gauges"]["staging.slots"] == 3.0
+
+
+def test_gauge_source_probed_each_tick():
+    reg = MetricsRegistry(MetricsSettings())
+    depth = {"v": 2}
+    reg.add_gauge_source("queue.filename.depth",
+                         lambda: depth["v"], capacity=100)
+    assert reg.snapshot(now=1.0)["gauges"]["queue.filename.depth"] \
+        == 2.0
+    depth["v"] = 9
+    assert reg.snapshot(now=2.0)["gauges"]["queue.filename.depth"] \
+        == 9.0
+
+
+# -- SLO layer --------------------------------------------------------
+
+def _card(rid, t0, t1, deadline_s=None):
+    tc = TimeCard(rid)
+    tc.record("enqueue_filename", at=t0)
+    tc.record("inference1_finish", at=t1)
+    if deadline_s is not None:
+        tc.deadline_s = deadline_s
+    return tc
+
+
+def test_slo_verdicts_from_deadline_stamp_and_budget():
+    reg = MetricsRegistry(MetricsSettings(), slo_budget_ms=100.0)
+    # deadline stamp wins when present
+    reg.note_completions([_card(1, 0.0, 5.0, deadline_s=6.0)],
+                         finish_s=1000.0)   # within its deadline
+    reg.note_completions([_card(2, 0.0, 5.0, deadline_s=4.0)],
+                         finish_s=1000.0)   # past its deadline
+    # no stamp: the job budget applies to the end-to-end span
+    reg.note_completions([_card(3, 0.0, 0.05)], finish_s=1000.0)
+    reg.note_completions([_card(4, 0.0, 0.5)], finish_s=1000.0)
+    assert (reg.slo_tracked, reg.slo_within, reg.slo_missed) \
+        == (4, 2, 2)
+
+
+def test_slo_without_any_budget_counts_all_within():
+    reg = MetricsRegistry(MetricsSettings(), slo_budget_ms=None)
+    reg.note_completions([_card(1, 0.0, 99.0)], finish_s=1000.0)
+    assert (reg.slo_tracked, reg.slo_within, reg.slo_missed) \
+        == (1, 1, 0)
+
+
+def test_burn_rate_matches_hand_computed_window():
+    reg = MetricsRegistry(MetricsSettings(), slo_budget_ms=100.0)
+    now = 1000.0
+    # 8 within + 2 late completions inside one window
+    for rid in range(8):
+        reg.note_completions([_card(rid, 0.0, 0.01)], finish_s=now)
+    for rid in range(8, 10):
+        reg.note_completions([_card(rid, 0.0, 5.0)], finish_s=now)
+    snap = reg.snapshot(now=now + 0.5)
+    # hand-computed: good 0.8/s, miss 0.2/s over the 10 s window;
+    # miss fraction 0.2 against the 1% error budget => burn 20
+    assert snap["rates"]["slo.good"] == pytest.approx(0.8)
+    assert snap["rates"]["slo.miss"] == pytest.approx(0.2)
+    assert snap["gauges"]["slo.goodput_vps"] == pytest.approx(0.8)
+    assert snap["gauges"]["slo.burn_rate"] == pytest.approx(
+        (0.2 / 1.0) / (1.0 - metrics.SLO_TARGET))
+    assert reg.burn_max == pytest.approx(
+        snap["gauges"]["slo.burn_rate"])
+    # the ledger counters partition
+    c = snap["counters"]
+    assert c["slo.tracked"] == c["slo.within"] + c["slo.missed"] == 10
+
+
+def test_sheds_count_into_burn_via_slo_miss():
+    reg = MetricsRegistry(MetricsSettings(), slo_budget_ms=100.0)
+    now = 1000.0
+    for rid in range(9):
+        reg.note_completions([_card(rid, 0.0, 0.01)], finish_s=now)
+    # a shed request (control.FaultStats bridge) is an SLO violation
+    reg.mark_rate("slo.miss", 1, now=now)
+    reg.mark_rate("faults.sheds", 1, now=now)
+    snap = reg.snapshot(now=now + 0.1)
+    assert snap["gauges"]["slo.burn_rate"] == pytest.approx(
+        (0.1 / 1.0) / (1.0 - metrics.SLO_TARGET))
+
+
+# -- flight recorder --------------------------------------------------
+
+def _armed_registry(tmp_path, ring_events=64, max_dumps=2,
+                    cooldown_s=100.0):
+    settings = MetricsSettings(
+        flight_recorder={"ring_events": ring_events,
+                         "max_dumps": max_dumps,
+                         "cooldown_s": cooldown_s})
+    reg = MetricsRegistry(settings, job_dir=str(tmp_path),
+                          job_id="flight-test")
+    reg.bridge = SpanBridge(reg, ring_events=settings.ring_events)
+    return reg
+
+
+def test_ring_evicts_oldest_and_dump_validates(tmp_path):
+    reg = _armed_registry(tmp_path, ring_events=4)
+    trace.ACTIVE = reg.bridge
+    for idx in range(10):
+        with trace.span("exec0.model_call", rid=idx):
+            pass
+    events = reg.bridge.ring_events()
+    assert len(events) == 4
+    assert [e[5] for e in events] == [6, 7, 8, 9]  # oldest evicted
+    reg.request_dump("forced", {"why": "test"})
+    reg.tick(now=time.time())
+    path = str(tmp_path / "flight-0.json")
+    assert os.path.isfile(path)
+    assert validate_trace(path) == []
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["otherData"]["flight_trigger"] == "forced"
+    assert doc["otherData"]["metric_window"]  # snapshots embedded
+    # a truncated ring must read as truncated: the 6 evicted events
+    # surface as the dump's dropped count, never as a complete window
+    assert doc["otherData"]["dropped_events"] == 6
+    assert reg.num_dumps == 1
+
+
+def test_dump_budget_and_cooldown(tmp_path):
+    reg = _armed_registry(tmp_path, max_dumps=2, cooldown_s=1000.0)
+    trace.ACTIVE = reg.bridge
+    with trace.span("exec0.model_call", rid=1):
+        pass
+    # same-kind triggers inside the cooldown collapse to one dump
+    reg.request_dump("circuit_open", {"lane": 1})
+    reg.request_dump("circuit_open", {"lane": 2})
+    # a different kind dumps, further kinds hit the budget
+    reg.request_dump("shed_spike")
+    reg.request_dump("slo_burn")
+    reg.tick()
+    names = sorted(p for p in os.listdir(str(tmp_path))
+                   if p.startswith("flight-"))
+    assert names == ["flight-0.json", "flight-1.json"]
+    assert reg.num_dumps == 2
+    assert reg.num_triggers == 4
+
+
+def test_burn_threshold_trigger_fires_from_flusher(tmp_path):
+    reg = _armed_registry(tmp_path)
+    reg.settings.burn_threshold = 2.0
+    reg.slo_budget_ms = 100.0
+    trace.ACTIVE = reg.bridge
+    with trace.span("exec0.model_call", rid=1):
+        pass
+    now = 1000.0
+    for rid in range(10):  # all late: burn = 100x the budget
+        reg.note_completions([_card(rid, 0.0, 5.0)], finish_s=now)
+    reg.tick(now=now + 0.1)
+    doc = json.load(open(str(tmp_path / "flight-0.json")))
+    assert doc["otherData"]["flight_trigger"] == "slo_burn"
+
+
+def test_queue_saturation_trigger(tmp_path):
+    reg = _armed_registry(tmp_path)
+    trace.ACTIVE = reg.bridge
+    with trace.span("exec0.model_call", rid=1):
+        pass
+    reg.add_gauge_source("queue.filename.depth", lambda: 95,
+                         capacity=100)
+    reg.tick(now=1000.0)
+    doc = json.load(open(str(tmp_path / "flight-0.json")))
+    assert doc["otherData"]["flight_trigger"] == "queue_saturation"
+    assert doc["otherData"]["flight_detail"]["queue"] \
+        == "queue.filename.depth"
+
+
+def test_recorder_off_keeps_triggers_inert(tmp_path):
+    settings = MetricsSettings(flight_recorder={"enabled": False})
+    reg = MetricsRegistry(settings, job_dir=str(tmp_path))
+    reg.bridge = SpanBridge(reg, ring_events=0)
+    reg.request_dump("circuit_open")
+    reg.tick()
+    assert not [p for p in os.listdir(str(tmp_path))
+                if p.startswith("flight-")]
+
+
+# -- span bridge ------------------------------------------------------
+
+def test_span_bridge_feeds_histograms_and_forwards():
+    reg = MetricsRegistry(MetricsSettings())
+    tracer = Tracer(TraceSettings(sample_hz=0))
+    bridge = SpanBridge(reg, forward=tracer, ring_events=8)
+    reg.bridge = bridge
+    trace.ACTIVE = bridge
+    with trace.span("exec0.model_call", rid=3):
+        pass
+    trace.instant("health.lane_state", args={"lane": 1})
+    trace.instant("client.enqueue", rid=3)  # not a declared metric
+    snap = reg.snapshot(now=1.0)
+    assert snap["histograms"]["exec0.model_call"]["count"] == 1
+    assert snap["counters"]["health.lane_state"] == 1
+    assert "client.enqueue" not in snap["counters"]
+    # the real tracer saw everything, bridged or not
+    assert tracer.num_events() == 3
+
+
+def test_bridge_cache_does_not_launder_undeclared_site_names():
+    reg = MetricsRegistry(MetricsSettings())
+    # seen first through the bridge (silently skipped there) ...
+    reg.bridge_event("client.enqueue", "i", 0.0)
+    # ... a direct call-site use of the same undeclared name still
+    # fails loudly
+    with pytest.raises(ValueError, match="not declared"):
+        reg.inc_counter("client.enqueue")
+
+
+# -- flusher thread ---------------------------------------------------
+
+def test_flusher_streams_snapshots_and_stops(tmp_path):
+    reg = MetricsRegistry(MetricsSettings(interval_ms=20),
+                          job_dir=str(tmp_path), job_id="flush-test")
+    metrics.ACTIVE = reg
+    reg.start()
+    deadline = time.monotonic() + 5.0
+    while reg.seq < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    reg.stop()
+    metrics.ACTIVE = None
+    lines = [json.loads(line) for line in
+             open(str(tmp_path / "metrics.jsonl"))
+             if line.strip()]
+    assert len(lines) >= 3
+    assert [rec["seq"] for rec in lines] \
+        == sorted(rec["seq"] for rec in lines)
+    # bounded memory: the in-registry window never exceeds its cap
+    assert len(reg._recent) <= 8
+    assert os.path.isfile(str(tmp_path / "metrics.prom"))
+
+
+def test_forced_dump_env_hook(tmp_path, monkeypatch):
+    reg = _armed_registry(tmp_path)
+    trace.ACTIVE = reg.bridge
+    with trace.span("exec0.model_call", rid=1):
+        pass
+    monkeypatch.setenv(metrics.FORCE_DUMP_ENV, "1")
+    reg.start()
+    reg.stop()
+    assert os.path.isfile(str(tmp_path / "flight-0.json"))
+    assert validate_trace(str(tmp_path / "flight-0.json")) == []
+
+
+def test_exposition_format(tmp_path):
+    reg = MetricsRegistry(MetricsSettings(), job_dir=str(tmp_path))
+    reg.inc_counter("client.requests", 5)
+    reg.set_gauge("queue.filename.depth", 3)
+    reg.observe_ms("exec0.model_call", 4.0)
+    reg.snapshot(now=1.0)
+    reg._write_exposition(str(tmp_path / "metrics.prom"))
+    text = open(str(tmp_path / "metrics.prom")).read()
+    assert "# TYPE rnb_client_requests counter\n" \
+           "rnb_client_requests 5\n" in text
+    assert "rnb_queue_filename_depth 3" in text
+    assert 'rnb_exec0_model_call_ms_bucket{le="+Inf"} 1' in text
+    assert "rnb_exec0_model_call_ms_count 1" in text
+
+
+# -- e2e: metrics-enabled and metrics-off tiny pipeline runs ----------
+
+def _run(tmp_path, run_name, metrics_value, extra=None, videos=40,
+         interval_ms=1):
+    from rnb_tpu.benchmark import run_benchmark
+    cfg = _cfg(metrics_value, extra)
+    if metrics_value is None:
+        del cfg["metrics"]
+    path = os.path.join(str(tmp_path), "%s.json" % run_name)
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return run_benchmark(path, mean_interval_ms=interval_ms,
+                         num_videos=videos, queue_size=50,
+                         log_base=os.path.join(str(tmp_path),
+                                               "logs-%s" % run_name),
+                         print_progress=False)
+
+
+def _parse_utils():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+    return parse_utils
+
+
+def test_metrics_deadline_run_end_to_end(tmp_path):
+    res = _run(tmp_path, "live",
+               {"enabled": True, "interval_ms": 20},
+               extra={"deadline": {"budget_ms": 500}}, videos=60)
+    assert res.termination_flag == 0
+    assert res.metrics_snapshots >= 3
+    assert res.slo_tracked >= res.slo_within > 0
+    assert res.slo_within + res.slo_missed == res.slo_tracked
+    # the module hook is cleared: nothing leaks into later runs
+    assert metrics.ACTIVE is None and trace.ACTIVE is None
+
+    jsonl = os.path.join(res.log_dir, "metrics.jsonl")
+    assert os.path.isfile(jsonl)
+    lines = [json.loads(line) for line in open(jsonl) if line.strip()]
+    assert len(lines) == res.metrics_snapshots
+    final = lines[-1]["counters"]
+    # the footing contract: the final snapshot equals the ledgers
+    assert final["faults.num_failed"] == res.num_failed
+    assert final["faults.num_shed"] == res.num_shed
+    assert final["deadline.expired"] == res.deadline_expired
+    assert final["slo.tracked"] == res.slo_tracked
+    # >=, not ==: the open-loop poisson client may legally create one
+    # request past the target before it observes termination
+    assert final["client.requests"] >= 60
+    # bridged histograms from the existing executor spans
+    hists = lines[-1]["histograms"]
+    assert hists["exec0.model_call"]["count"] > 0
+    assert hists["exec1.model_call"]["count"] > 0
+    assert os.path.isfile(os.path.join(res.log_dir, "metrics.prom"))
+
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Metrics: snapshots=%d" % res.metrics_snapshots in meta_text
+    assert "Slo: tracked=%d" % res.slo_tracked in meta_text
+
+    parse_utils = _parse_utils()
+    try:
+        assert parse_utils.check_job(res.log_dir) == []
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+
+def test_metrics_and_trace_compose(tmp_path):
+    # both planes on: the bridge forwards to the real tracer, so the
+    # trace artifact stays complete AND the metrics plane streams
+    res = _run(tmp_path, "both",
+               {"enabled": True, "interval_ms": 20},
+               extra={"trace": {"enabled": True, "sample_hz": 100}})
+    assert res.termination_flag == 0
+    assert res.trace_events > 0
+    assert res.metrics_snapshots >= 1
+    assert validate_trace(os.path.join(res.log_dir,
+                                       "trace.json")) == []
+    parse_utils = _parse_utils()
+    try:
+        assert parse_utils.check_job(res.log_dir) == []
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+
+def test_check_catches_metrics_drift(tmp_path):
+    res = _run(tmp_path, "drift", {"enabled": True, "interval_ms": 20})
+    assert res.termination_flag == 0
+    jsonl = os.path.join(res.log_dir, "metrics.jsonl")
+    lines = open(jsonl).read().splitlines()
+    final = json.loads(lines[-1])
+    final["counters"]["faults.num_failed"] += 7  # cook the books
+    with open(jsonl, "w") as f:
+        f.write("\n".join(lines[:-1]
+                          + [json.dumps(final, sort_keys=True)]) + "\n")
+    parse_utils = _parse_utils()
+    try:
+        problems = parse_utils.check_job(res.log_dir)
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+    assert any("does not foot" in p for p in problems)
+
+
+def test_metrics_off_run_stays_byte_stable(tmp_path):
+    res = _run(tmp_path, "plain", None)
+    assert res.termination_flag == 0
+    assert res.metrics_snapshots == 0 and res.slo_tracked == 0
+    for artifact in ("metrics.jsonl", "metrics.prom", "flight-0.json"):
+        assert not os.path.isfile(os.path.join(res.log_dir, artifact))
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Metrics:" not in meta_text and "Slo:" not in meta_text
+    tables = [n for n in os.listdir(res.log_dir) if "group" in n]
+    with open(os.path.join(res.log_dir, tables[0])) as f:
+        report = f.read()
+    # the stamp schema is exactly the pre-metrics set
+    header = report.split("\n", 1)[0].split()
+    assert header == ["enqueue_filename", "runner0_start",
+                      "inference0_start", "inference0_finish",
+                      "runner1_start", "inference1_start",
+                      "inference1_finish", "device0", "device1"]

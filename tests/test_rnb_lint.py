@@ -248,6 +248,62 @@ def test_dead_trace_registry_entry():
         == {("RNB-T003", "ghost.event")}
 
 
+_T009_REGISTRY = None
+
+
+def _t009_registry():
+    from rnb_tpu.telemetry import MetricSpec
+    return (MetricSpec("good.requests", "counter", "site", "f"),
+            MetricSpec("good.depth", "gauge", "site", "f"),
+            MetricSpec("good.latency", "histogram", "site", "f"),
+            MetricSpec("good.arrivals", "rate", "site", "f"),
+            MetricSpec("good.e{step}.depth", "gauge", "site", "f"))
+
+
+def test_metric_fixture_is_clean():
+    from rnb_tpu.analysis.schema import check_metric_names
+    findings = check_metric_names([_fixture("good_t009_metrics.py")],
+                                  root=FIXTURES,
+                                  registry=_t009_registry())
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_unregistered_metric_triggers_t009():
+    from rnb_tpu.analysis.schema import check_metric_names
+    findings = check_metric_names([_fixture("bad_t009_metrics.py")],
+                                  root=FIXTURES,
+                                  registry=_t009_registry())
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T009", "mystery.series")}
+
+
+def test_dead_site_metric_registry_entry():
+    # a registered SITE-sourced metric no call site emits is an
+    # RNB-T003 dead entry; bridge/poll/derived entries have no call
+    # sites by design and must NOT be flagged
+    from rnb_tpu.analysis.schema import check_metric_names
+    from rnb_tpu.telemetry import MetricSpec
+    registry = _t009_registry() + (
+        MetricSpec("ghost.series", "counter", "site", "never emitted"),
+        MetricSpec("bridged.series", "histogram", "bridge", "no site"),
+        MetricSpec("polled.series", "counter", "poll", "no site"),
+        MetricSpec("derived.series", "gauge", "derived", "no site"))
+    findings = check_metric_names([_fixture("good_t009_metrics.py")],
+                                  root=FIXTURES, registry=registry)
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T003", "ghost.series")}
+
+
+def test_repo_metric_names_all_registered():
+    # the real tree: every emitted metric series name is declared and
+    # every declared site-sourced name is still emitted somewhere
+    from rnb_tpu.analysis.findings import package_py_files
+    from rnb_tpu.analysis.schema import check_metric_names
+    findings = check_metric_names(
+        package_py_files(os.path.join(REPO, "rnb_tpu")), root=REPO)
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_repo_trace_events_all_registered():
     # the real tree: every emitted trace event name is declared and
     # every declared name is still emitted somewhere
@@ -299,6 +355,8 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Hedge: fired=%d\\n" % hg)\n'
                      'f.write("Compiles: %s\\n" % c)\n'
                      'f.write("Warmup: %s\\n" % w)\n'
+                     'f.write("Metrics: snapshots=%d\\n" % ms)\n'
+                     'f.write("Slo: tracked=%d\\n" % sl)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -344,7 +402,11 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'routes_after_open=%d\\n" % hl)\n'
         'f.write("Deadline: budget_ms=%d expired=%d\\n" % dl)\n'
         'f.write("Hedge: fired=%d won=%d lost=%d wasted_ms=%d\\n" '
-        '% hg)\n')
+        '% hg)\n'
+        'f.write("Metrics: snapshots=%d series=%d dumps=%d '
+        'triggers=%d\\n" % ms)\n'
+        'f.write("Slo: tracked=%d within=%d missed=%d '
+        'burn_max_milli=%d\\n" % sl)\n')
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}
