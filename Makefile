@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace ragged multichip chaos metrics
+.PHONY: lint test native stamps trace ragged multichip chaos metrics dct
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -62,6 +62,14 @@ chaos:
 # streams, foots, and black-boxes incidents.
 metrics:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_demo.py
+
+# DCT-domain ingest gate (README "DCT-domain ingest"): same-seed
+# yuv420-vs-dct A/B over a generated 112x112 MJPEG dataset, asserting
+# logit parity through the fused on-device IDCT, one compiled shape on
+# the dct network stage, host->device bytes/frame <= 0.5x the yuv420
+# arm, and parse_utils --check green on both arms.
+dct:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/dct_demo.py
 
 native:
 	$(MAKE) -C native

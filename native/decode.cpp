@@ -49,6 +49,7 @@ constexpr int kErrIo = -1;        // open/seek/read failure
 constexpr int kErrFormat = -2;    // not a y4m / bad header / bad marker
 constexpr int kErrColorspace = -3;
 constexpr int kErrArg = -4;
+constexpr int kErrBudget = -5;    // dct: spectrum exceeds the wire budget
 
 struct Y4mMeta {
   int width = 0;
@@ -385,12 +386,75 @@ struct JpegComponent {
   std::vector<unsigned char> plane;
 };
 
+// DCT-coefficient decode mode (pixel_path "dct", rnb_tpu/ops/dct.py):
+// the entropy decode stops at dequantized zigzag coefficients — no
+// Idct8x8, no pixel planes, the per-pixel host work this mode exists
+// to delete. Blocks land plane-major (Y raster, then U, then V) so
+// the packed wire stream is container-order independent of the MCU
+// interleave.
+struct CoeffSink {
+  std::vector<short> dense;  // nb x 64, zigzag order within a block
+  std::vector<int> last;     // highest zigzag index written per block
+  int nb = 0;
+  int blocks_w_y = 0;        // luma blocks per row
+  int ny = 0;                // luma block count
+  int nc = 0;                // per-chroma-plane block count
+
+  void Reset(int w, int h) {
+    blocks_w_y = w / 8;
+    ny = (h / 8) * blocks_w_y;
+    nc = (h / 16) * (w / 16);
+    nb = ny + 2 * nc;
+    dense.assign(static_cast<size_t>(nb) * 64, 0);
+    last.assign(nb, 0);
+  }
+};
+
+inline short ClampCoeff(float v) {
+  if (v < -32768.f) v = -32768.f;
+  if (v > 32767.f) v = 32767.f;
+  return static_cast<short>(v);
+}
+
+// Pack one decoded frame's coefficients into the wire row layout
+// (rnb_tpu/ops/dct.py): per-block nonzero counts, then values, then
+// zigzag positions, padded with zeros to `capacity` entries each.
+// kErrBudget when the frame's spectrum does not fit — truncating it
+// would silently change pixels, so the caller surfaces a classified
+// error instead.
+int PackCoeffFrame(const CoeffSink& sink, int capacity, short* out) {
+  const int nb = sink.nb;
+  std::memset(out, 0,
+              sizeof(short) * (static_cast<size_t>(nb) + 2 * capacity));
+  int cursor = 0;
+  for (int b = 0; b < nb; ++b) {
+    const short* drow = sink.dense.data() + static_cast<size_t>(b) * 64;
+    int cnt = 0;
+    for (int k = 0; k <= sink.last[b]; ++k) {
+      if (!drow[k]) continue;
+      if (cursor >= capacity) return kErrBudget;
+      out[nb + cursor] = drow[k];
+      out[nb + capacity + cursor] = static_cast<short>(k);
+      ++cursor;
+      ++cnt;
+    }
+    out[b] = static_cast<short>(cnt);
+  }
+  return 0;
+}
+
 // Decode one baseline JPEG into planar samples at source geometry.
 // On success fills width/height/subsample and the payload vector in
 // y4m plane order (Y, then Cb, Cr at w/sub x h/sub).
+// With `sink` non-null the decode STOPS at entropy-decoded,
+// dequantized zigzag coefficients (plain integer dequant, no AAN
+// scale fold, no IDCT, no pixel planes) — the pixel_path "dct" cut
+// point; payload is untouched and 4:2:0 whole-MCU geometry is
+// required.
 int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                     int* height, int* subsample,
-                    std::vector<unsigned char>* payload) {
+                    std::vector<unsigned char>* payload,
+                    CoeffSink* sink = nullptr) {
   if (n < 4 || data[0] != 0xFF || data[1] != 0xD8) return kErrFormat;
   unsigned short qt[4][64];
   bool qt_ok[4] = {false, false, false, false};
@@ -510,6 +574,14 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
   } else {
     return kErrColorspace;
   }
+  if (sink != nullptr) {
+    // the coefficient wire format is 4:2:0 whole-MCU only: no resize
+    // exists in the coefficient domain, so partial edge blocks would
+    // ship spectrum for pixels the consumer never shows
+    if (sub != 2) return kErrColorspace;
+    if (w % 16 || h % 16) return kErrColorspace;
+    sink->Reset(w, h);
+  }
   const int maxh = comps[0].h, maxv = comps[0].v;
   const int mcus_x = (w + 8 * maxh - 1) / (8 * maxh);
   const int mcus_y = (h + 8 * maxv - 1) / (8 * maxv);
@@ -517,22 +589,27 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
     if (!qt_ok[comps[c].tq] || !hdc[comps[c].td].present ||
         !hac[comps[c].ta].present)
       return kErrFormat;
+    if (sink != nullptr) continue;  // no pixel planes in coeff mode
     comps[c].plane_w = mcus_x * comps[c].h * 8;
     comps[c].plane_h = mcus_y * comps[c].v * 8;
     comps[c].plane.assign(
         static_cast<size_t>(comps[c].plane_w) * comps[c].plane_h, 0);
   }
-  // dequant tables with the AAN scale factors and /8 normalization
-  // folded in (indexed in zigzag scan order like the raw tables);
-  // built AFTER the qt_ok validation so an undefined table never
-  // feeds the fold
+  // dequant tables, indexed in zigzag scan order like the raw tables;
+  // pixel mode folds in the AAN scale factors and /8 normalization,
+  // coefficient mode keeps the RAW quantizer (plain integer dequant —
+  // the values are exact small integers in float). Built AFTER the
+  // qt_ok validation so an undefined table never feeds the fold.
   float fq[4][64];
   for (int c = 0; c < ncomp; ++c) {
     const int tq_id = comps[c].tq;
     for (int k = 0; k < 64; ++k) {
       const int nat = kZigzag[k];
-      fq[tq_id][k] = static_cast<float>(qt[tq_id][k]) *
-                     kAanScale[nat >> 3] * kAanScale[nat & 7] / 8.0f;
+      fq[tq_id][k] = sink != nullptr
+                         ? static_cast<float>(qt[tq_id][k])
+                         : static_cast<float>(qt[tq_id][k]) *
+                               kAanScale[nat >> 3] * kAanScale[nat & 7] /
+                               8.0f;
     }
   }
   BitReader br(data + scan_start, n - scan_start);
@@ -559,7 +636,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
             dc_pred[c] += diff;
             std::memset(blk, 0, sizeof(blk));
             blk[0] = static_cast<float>(dc_pred[c]) * q[0];
-            int k = 1, row_mask = 1;
+            int k = 1, row_mask = 1, last_k = 0;
             bool ac_any = false;
             const HuffTable& act = hac[comp.ta];
             while (k < 64) {
@@ -585,6 +662,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                       static_cast<float>(Extend(vraw, s_)) * q[k];
                   row_mask |= 1 << (nat >> 3);
                   ac_any = true;
+                  last_k = k;
                   ++k;
                   continue;
                 }
@@ -601,6 +679,7 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                       Extend(br.GetBits(s_), s_)) * q[k];
                   row_mask |= 1 << (nat >> 3);
                   ac_any = true;
+                  last_k = k;
                   ++k;
                   continue;
                 }
@@ -610,6 +689,22 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
                 continue;
               }
               break;  // EOB
+            }
+            if (sink != nullptr) {
+              // coefficient mode: the block's dequantized zigzag
+              // prefix IS the output — blk holds exact integers
+              // (raw value x raw quantizer) in natural order
+              const int bidx =
+                  c == 0 ? (my * comp.v + by) * sink->blocks_w_y +
+                               (mx * comp.h + bx)
+                         : sink->ny + (c - 1) * sink->nc +
+                               my * mcus_x + mx;
+              short* drow =
+                  sink->dense.data() + static_cast<size_t>(bidx) * 64;
+              for (int k2 = 0; k2 <= last_k; ++k2)
+                drow[k2] = ClampCoeff(blk[kZigzag[k2]]);
+              sink->last[bidx] = last_k;
+              continue;
             }
             const int px = (mx * comp.h + bx) * 8;
             const int py = (my * comp.v + by) * 8;
@@ -632,6 +727,13 @@ int DecodeJpegFrame(const unsigned char* data, size_t n, int* width,
         }
       }
     }
+  }
+  if (sink != nullptr) {
+    // coefficient mode: no pixel payload to crop
+    *width = w;
+    *height = h;
+    *subsample = sub;
+    return 0;
   }
   // crop the MCU-padded planes into the packed y4m payload layout
   const int cw = w / sub, chh = h / sub;
@@ -812,7 +914,8 @@ int SniffContainer(const char* path) {
 
 int DecodeClipsMjpeg(const char* path, const long long* clip_starts,
                      int num_clips, int consecutive, int out_w,
-                     int out_h, unsigned char* out, int pixfmt);
+                     int out_h, unsigned char* out, int pixfmt,
+                     int dct_capacity);
 
 // Convert one source frame payload into the caller's RGB output tile,
 // fusing nearest chroma upsample + box resize (out[r][c] samples
@@ -950,21 +1053,29 @@ void GatherFrameYUV(const unsigned char* payload, const Y4mMeta& m,
 
 constexpr int kPixRgb = 0;     // fused convert+resize, RGB u8 out
 constexpr int kPixYuv420 = 1;  // gather-only, packed 4:2:0 planes out
+constexpr int kPixDct = 2;     // dequantized coefficients, int16 rows
 
 int DecodeClips(const char* path, const long long* clip_starts,
                 int num_clips, int consecutive, int out_w, int out_h,
-                unsigned char* out, int pixfmt = kPixRgb) {
+                unsigned char* out, int pixfmt = kPixRgb,
+                int dct_capacity = 0) {
   if (num_clips < 0 || consecutive <= 0 || out_w <= 0 || out_h <= 0 ||
       out == nullptr)
     return kErrArg;
-  if (pixfmt != kPixRgb && pixfmt != kPixYuv420) return kErrArg;
+  if (pixfmt != kPixRgb && pixfmt != kPixYuv420 && pixfmt != kPixDct)
+    return kErrArg;
   if (pixfmt == kPixYuv420 && (out_w % 2 != 0 || out_h % 2 != 0))
     return kErrArg;  // packed 4:2:0 needs even output geometry
+  if (pixfmt == kPixDct &&
+      (dct_capacity < 1 || out_w % 16 != 0 || out_h % 16 != 0))
+    return kErrArg;  // coefficient rows need whole-MCU geometry
   const int container = SniffContainer(path);
   if (container < 0) return container;
   if (container == 1)
     return DecodeClipsMjpeg(path, clip_starts, num_clips, consecutive,
-                            out_w, out_h, out, pixfmt);
+                            out_w, out_h, out, pixfmt, dct_capacity);
+  if (pixfmt == kPixDct)
+    return kErrFormat;  // uncompressed y4m carries no coefficients
   Y4mMeta m;
   int rc = ProbeFile(path, &m);
   if (rc != 0) return rc;
@@ -1018,10 +1129,16 @@ int DecodeClips(const char* path, const long long* clip_starts,
 // are identical to the y4m leg (and the numpy backend).
 int DecodeClipsMjpeg(const char* path, const long long* clip_starts,
                      int num_clips, int consecutive, int out_w,
-                     int out_h, unsigned char* out, int pixfmt) {
+                     int out_h, unsigned char* out, int pixfmt,
+                     int dct_capacity) {
   MjpegIndex idx;
   int rc = GetMjpegIndex(path, &idx);
   if (rc != 0) return rc;
+  if (pixfmt == kPixDct &&
+      (idx.width != out_w || idx.height != out_h))
+    // no resize exists in the coefficient domain: the caller must ask
+    // for exactly the source geometry
+    return kErrColorspace;
   FILE* f = fopen(path, "rb");
   if (!f) return kErrIo;
   Y4mMeta m;  // geometry carrier for the shared convert/gather stages
@@ -1031,10 +1148,16 @@ int DecodeClipsMjpeg(const char* path, const long long* clip_starts,
   m.count = static_cast<long long>(idx.offsets.size());
   std::vector<unsigned char> compressed, payload;
   std::vector<int> col_map;
+  CoeffSink sink;
   const long long frame_out =
-      pixfmt == kPixYuv420
-          ? static_cast<long long>(out_h) * out_w * 3 / 2
-          : static_cast<long long>(out_h) * out_w * 3;
+      pixfmt == kPixDct
+          ? (static_cast<long long>((out_h / 8) * (out_w / 8) +
+                                    2 * (out_h / 16) * (out_w / 16)) +
+             2 * dct_capacity) *
+                static_cast<long long>(sizeof(short))
+          : pixfmt == kPixYuv420
+                ? static_cast<long long>(out_h) * out_w * 3 / 2
+                : static_cast<long long>(out_h) * out_w * 3;
   long long last_idx = -1;
   for (int ci = 0; ci < num_clips; ++ci) {
     if (clip_starts[ci] < 0) {
@@ -1056,17 +1179,26 @@ int DecodeClipsMjpeg(const char* path, const long long* clip_starts,
         }
         int w, h, sub;
         rc = DecodeJpegFrame(compressed.data(), compressed.size(), &w,
-                             &h, &sub, &payload);
+                             &h, &sub, &payload,
+                             pixfmt == kPixDct ? &sink : nullptr);
         if (rc != 0 || w != m.width || h != m.height ||
             sub != m.subsample) {
           fclose(f);
           return rc != 0 ? rc : kErrFormat;
         }
         last_idx = idx_f;
-        if (pixfmt == kPixYuv420)
+        if (pixfmt == kPixDct) {
+          rc = PackCoeffFrame(sink, dct_capacity,
+                              reinterpret_cast<short*>(dst));
+          if (rc != 0) {
+            fclose(f);
+            return rc;
+          }
+        } else if (pixfmt == kPixYuv420) {
           GatherFrameYUV(payload.data(), m, out_w, out_h, dst, &col_map);
-        else
+        } else {
           ConvertFrame(payload.data(), m, out_w, out_h, dst, &col_map);
+        }
       } else {
         std::memcpy(dst, dst - frame_out, frame_out);
       }
@@ -1085,6 +1217,7 @@ struct Job {
   std::vector<long long> starts;
   int consecutive, out_w, out_h;
   int pixfmt = kPixRgb;
+  int dct_capacity = 0;  // per-frame coefficient budget (kPixDct only)
   unsigned char* out;
 };
 
@@ -1115,7 +1248,8 @@ struct Pool {
       const int rc = DecodeClips(
           job.path.c_str(), job.starts.data(),
           static_cast<int>(job.starts.size()), job.consecutive,
-          job.out_w, job.out_h, job.out, job.pixfmt);
+          job.out_w, job.out_h, job.out, job.pixfmt,
+          job.dct_capacity);
       {
         std::lock_guard<std::mutex> lk(mu);
         done[job.ticket] = rc;
@@ -1250,6 +1384,40 @@ long long rnb_pool_submit_fmt(void* pool, const char* path,
   job.out_h = out_h;
   job.pixfmt = pixfmt;
   job.out = out;
+  return static_cast<Pool*>(pool)->Submit(std::move(job));
+}
+
+// pixel_path "dct" (rnb_tpu/ops/dct.py): decode MJPEG clips stopping
+// at dequantized DCT coefficients, packed into int16 wire rows of
+// (num_blocks + 2 * coeff_capacity) elements per frame. out_w/out_h
+// must equal the source geometry (divisible by 16, 4:2:0 only). New
+// export: a stale prebuilt library fails the symbol check in
+// rnb_tpu/decode/native.py and degrades cleanly.
+int rnb_y4m_decode_clips_dct(const char* path,
+                             const long long* clip_starts,
+                             int num_clips, int consecutive, int out_w,
+                             int out_h, int coeff_capacity,
+                             short* out) {
+  return DecodeClips(path, clip_starts, num_clips, consecutive, out_w,
+                     out_h, reinterpret_cast<unsigned char*>(out),
+                     kPixDct, coeff_capacity);
+}
+
+long long rnb_pool_submit_dct(void* pool, const char* path,
+                              const long long* clip_starts,
+                              int num_clips, int consecutive,
+                              int out_w, int out_h, int coeff_capacity,
+                              short* out) {
+  if (!pool || num_clips < 0 || coeff_capacity < 1) return -1;
+  Job job;
+  job.path = path;
+  job.starts.assign(clip_starts, clip_starts + num_clips);
+  job.consecutive = consecutive;
+  job.out_w = out_w;
+  job.out_h = out_h;
+  job.pixfmt = kPixDct;
+  job.dct_capacity = coeff_capacity;
+  job.out = reinterpret_cast<unsigned char*>(out);
   return static_cast<Pool*>(pool)->Submit(std::move(job));
 }
 

@@ -171,7 +171,8 @@ class ClipCache:
                             int(device_batch.nbytes))
 
     def insert_host(self, key: tuple, clips, valid: int,
-                    target_shape: Tuple[int, ...]) -> bool:
+                    target_shape: Tuple[int, ...],
+                    dtype=np.uint8) -> bool:
         """Pad host clips to ``target_shape`` and transfer, then insert.
 
         Used by the fusing loader, whose misses cross the wire inside a
@@ -189,14 +190,16 @@ class ClipCache:
         transfer handoff) — after that, the cached device array owns
         independent bytes and can never observe a slot reuse.
         """
-        if int(np.prod(target_shape)) > self.capacity_bytes:
+        dtype = np.dtype(dtype)
+        if int(np.prod(target_shape)) * dtype.itemsize \
+                > self.capacity_bytes:
             with self._lock:
                 self.num_oversize += 1
             return False
         if self.contains(key):
             return False
         jax, _ = _jax_numpy()
-        padded = np.zeros(target_shape, dtype=np.uint8)
+        padded = np.zeros(target_shape, dtype=dtype)
         padded[:valid] = clips[:valid]
         device_batch = jax.device_put(padded, self.device)
         return self.insert_device(key, device_batch, valid)
@@ -214,10 +217,11 @@ class ClipCache:
         staging-slot view about to recycle, same contract as
         :meth:`insert_host`), and charges exactly ``valid`` rows of
         bytes — a 1-clip entry costs 1/15th of its bucket-padded
-        equivalent.
+        equivalent. The rows keep the loader's wire dtype (uint8
+        pixels/planes, int16 packed dct coefficients).
         """
         valid = int(valid)
-        rows = np.array(np.asarray(clips)[:valid], dtype=np.uint8)
+        rows = np.array(np.asarray(clips)[:valid])
         return self._insert(key, rows, valid, int(rows.nbytes))
 
     def snapshot(self) -> Dict[str, int]:

@@ -58,6 +58,22 @@ class VideoDecoder:
         must be even."""
         raise NotImplementedError
 
+    def decode_clips_dct(self, video: str, clip_starts: List[int],
+                         consecutive_frames: int = 8,
+                         width: int = DEFAULT_WIDTH,
+                         height: int = DEFAULT_HEIGHT,
+                         coeffs: Optional[int] = None) -> np.ndarray:
+        """-> int16 (num_clips, consecutive_frames, elems): packed
+        dequantized DCT coefficient rows (rnb_tpu/ops/dct.py wire
+        format) for the DCT-domain ingest — the decode stops at
+        entropy-decoded coefficients, IDCT/upsample/convert run
+        on-device. MJPEG only; geometry must equal the source frame
+        geometry and be divisible by 16. ``coeffs`` is the per-frame
+        coefficient budget (None = the default half-of-yuv420 rule);
+        a frame whose spectrum exceeds it raises a classified
+        permanent error."""
+        raise NotImplementedError
+
 
 class SyntheticDecoder(VideoDecoder):
     """Procedural frames, deterministic per (video id, clip start).
@@ -100,6 +116,43 @@ class SyntheticDecoder(VideoDecoder):
             rng = np.random.default_rng(seed)
             out[i] = rng.integers(0, 256, (consecutive_frames, packed),
                                   dtype=np.uint8)
+        return out
+
+    def decode_clips_dct(self, video, clip_starts, consecutive_frames=8,
+                         width=DEFAULT_WIDTH, height=DEFAULT_HEIGHT,
+                         coeffs=None):
+        """Procedural sparse coefficient rows: a small per-block
+        zigzag-prefix spectrum — statistically like real quantized
+        video (energy in the first few frequencies) and always within
+        the wire budget, so synthetic benchmark arms exercise the real
+        unpack/IDCT compute path."""
+        from rnb_tpu.ops.dct import (dct_frame_elems, num_dct_blocks)
+        nb = num_dct_blocks(height, width)
+        elems = dct_frame_elems(height, width, coeffs)
+        budget = (elems - nb) // 2
+        if budget < nb:
+            raise ValueError(
+                "dct coefficient budget %d below one coefficient per "
+                "block (%d)" % (budget, nb))
+        kmax = min(6, budget // nb)
+        out = np.zeros((len(clip_starts), consecutive_frames, elems),
+                       dtype=np.int16)
+        for i, start in enumerate(clip_starts):
+            seed = zlib.crc32(("dct:%s@%d" % (video, start)).encode())
+            rng = np.random.default_rng(seed)
+            for fi in range(consecutive_frames):
+                counts = rng.integers(1, kmax + 1, nb)
+                total = int(counts.sum())
+                mags = rng.integers(1, 480, total)
+                signs = rng.integers(0, 2, total) * 2 - 1
+                # zigzag-prefix positions: 0..counts[b]-1 per block
+                cum = np.concatenate(([0], np.cumsum(counts)[:-1]))
+                poss = np.arange(total) - np.repeat(cum, counts)
+                row = out[i, fi]
+                row[:nb] = counts.astype(np.int16)
+                row[nb:nb + total] = (mags * signs).astype(np.int16)
+                row[nb + budget:nb + budget + total] = \
+                    poss.astype(np.int16)
         return out
 
 
@@ -270,6 +323,16 @@ class Y4MDecoder(VideoDecoder):
                     out[ci, fi] = self._gather_frame_yuv(
                         f.read(meta["frame_bytes"]), meta, maps)
         return out
+
+    def decode_clips_dct(self, video, clip_starts, consecutive_frames=8,
+                         width=DEFAULT_WIDTH, height=DEFAULT_HEIGHT,
+                         coeffs=None):
+        # classified permanent: an uncompressed container carries no
+        # DCT coefficients to stop at — the request dead-letters under
+        # containment instead of taking the run down
+        raise CorruptVideoError(
+            "the dct pixel path needs an MJPEG container; %s is "
+            "uncompressed y4m (no DCT coefficients to ship)" % video)
 
 
 def write_y4m(path: str, frames: np.ndarray,
@@ -479,6 +542,52 @@ class MjpegPILDecoder(VideoDecoder):
                 v = ycc[crows][:, ccols, 2]
                 out[ci, fi] = np.concatenate(
                     [y.ravel(), u.ravel(), v.ravel()])
+        return out
+
+    def decode_clips_dct(self, video, clip_starts, consecutive_frames=8,
+                         width=DEFAULT_WIDTH, height=DEFAULT_HEIGHT,
+                         coeffs=None):
+        """Packed dequantized coefficients via the pure-Python
+        entropy decoder (rnb_tpu/decode/jpeg_dct.py) — PIL/libjpeg
+        never exposes coefficients, so this backend IS the
+        independent oracle the native decoder is parity-tested
+        against. Clamp-past-end and repeat-frame semantics match the
+        pixel paths."""
+        from rnb_tpu.decode.jpeg_dct import jpeg_frame_dct
+        from rnb_tpu.ops.dct import dct_frame_elems, pack_frame_dct
+        elems = dct_frame_elems(height, width, coeffs)
+        data, frames = self._frames(video)
+        count = len(frames)
+        if any(s < 0 for s in clip_starts):
+            raise ValueError("negative clip start in %r" % (clip_starts,))
+        out = np.zeros((len(clip_starts), consecutive_frames, elems),
+                       dtype=np.int16)
+        last_idx = None
+        last_row = None
+        for ci, start in enumerate(clip_starts):
+            for fi in range(consecutive_frames):
+                idx = min(start + fi, count - 1)
+                if idx != last_idx:
+                    off, length = frames[idx]
+                    zz, w, h = jpeg_frame_dct(data[off:off + length])
+                    if (w, h) != (width, height):
+                        # no resize exists in the coefficient domain:
+                        # the source geometry must BE the requested one
+                        raise CorruptVideoError(
+                            "%s is %dx%d but the dct path was asked "
+                            "for %dx%d — coefficients cannot be "
+                            "resized on the host" % (video, w, h,
+                                                     width, height))
+                    try:
+                        last_row = pack_frame_dct(zz, height, width,
+                                                  coeffs)
+                    except ValueError as e:
+                        # over-budget spectrum: re-decoding cannot
+                        # shrink it — classified permanent
+                        raise CorruptVideoError(
+                            "%s frame %d: %s" % (video, idx, e)) from e
+                    last_idx = idx
+                out[ci, fi] = last_row
         return out
 
 

@@ -19,17 +19,23 @@ import numpy as np
 
 from rnb_tpu.decode import (DEFAULT_HEIGHT, DEFAULT_WIDTH, VideoDecoder)
 from rnb_tpu.faults import CorruptVideoError, TransientDecodeError
+from rnb_tpu.ops.dct import coeffs_from_elems, dct_frame_elems
 
 _ERR_MSGS = {
     -1: "I/O error",
-    -2: "not a y4m/mjpeg file / malformed stream",
-    -3: "unsupported colourspace/sampling",
+    -2: "not a y4m/mjpeg file / malformed stream (the dct path also "
+        "needs an MJPEG container)",
+    -3: "unsupported colourspace/sampling/geometry for this pixel "
+        "format",
     -4: "bad argument",
+    -5: "DCT spectrum exceeds the wire coefficient budget — raise "
+        "dct_coeffs_per_frame or use pixel_path yuv420",
 }
 
 #: pixel formats of the native decoder (native/decode.cpp kPix*)
 PIX_RGB = 0       # fused convert+resize -> (n, F, H, W, 3) u8
 PIX_YUV420 = 1    # gather-only packed planes -> (n, F, H*W*3//2) u8
+PIX_DCT = 2       # dequantized coefficients -> (n, F, elems) int16
 
 _lib = None
 _lib_checked = False
@@ -68,7 +74,8 @@ def load_native():
                     "rnb_y4m_decode_clips_fmt", "rnb_pool_create",
                     "rnb_pool_destroy", "rnb_pool_submit",
                     "rnb_pool_submit_fmt", "rnb_pool_wait",
-                    "rnb_pool_peek", "rnb_video_probe"):
+                    "rnb_pool_peek", "rnb_video_probe",
+                    "rnb_y4m_decode_clips_dct", "rnb_pool_submit_dct"):
             if not hasattr(lib, sym):
                 return None
         lib.rnb_y4m_probe.restype = ctypes.c_int
@@ -105,6 +112,17 @@ def load_native():
             ctypes.c_void_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+        lib.rnb_y4m_decode_clips_dct.restype = ctypes.c_int
+        lib.rnb_y4m_decode_clips_dct.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p]
+        lib.rnb_pool_submit_dct.restype = ctypes.c_longlong
+        lib.rnb_pool_submit_dct.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -136,7 +154,9 @@ def _check(rc: int, path: str) -> None:
            % (path, _ERR_MSGS.get(rc, "error %d" % rc)))
     if rc == -1:
         raise TransientDecodeError(msg)
-    if rc in (-2, -3):
+    if rc in (-2, -3, -5):
+        # -5 (over-budget spectrum) is permanent: re-decoding cannot
+        # shrink a frame's nonzero coefficient count
         raise CorruptVideoError(msg)
     raise ValueError(msg)
 
@@ -190,18 +210,23 @@ class DecodePool:
                     pixfmt: int = PIX_RGB,
                     width: int = DEFAULT_WIDTH,
                     height: int = DEFAULT_HEIGHT) -> int:
-        """Decode into a caller-provided C-contiguous uint8 view —
-        (clips, frames, H, W, 3) for PIX_RGB, (clips, frames, H*W*3//2)
-        packed planes for PIX_YUV420 (geometry comes from
-        width/height there; a packed length alone is ambiguous). Lets
-        one logical decode fan out over the pool by submitting chunks
-        that target disjoint slices of a single batch buffer."""
-        if out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"] \
+        """Decode into a caller-provided C-contiguous view — uint8
+        (clips, frames, H, W, 3) for PIX_RGB, uint8 (clips, frames,
+        H*W*3//2) packed planes for PIX_YUV420, int16 (clips, frames,
+        num_blocks + 2*C) coefficient rows for PIX_DCT (geometry comes
+        from width/height; a packed length alone is ambiguous, and the
+        dct coefficient budget C is recovered from the trailing axis).
+        Lets one logical decode fan out over the pool by submitting
+        chunks that target disjoint slices of a single batch buffer."""
+        want_dtype = np.int16 if pixfmt == PIX_DCT else np.uint8
+        if out.dtype != want_dtype or not out.flags["C_CONTIGUOUS"] \
                 or out.shape[:2] != (len(clip_starts),
                                      consecutive_frames):
-            raise ValueError("bad output buffer %r for %d clips x %d "
-                             "frames" % (out.shape, len(clip_starts),
+            raise ValueError("bad output buffer %r/%s for %d clips x %d "
+                             "frames" % (out.shape, out.dtype,
+                                         len(clip_starts),
                                          consecutive_frames))
+        dct_coeffs = 0
         if pixfmt == PIX_RGB:
             if out.ndim != 5 or out.shape[4] != 3:
                 raise ValueError("PIX_RGB wants (clips, frames, H, W, 3)"
@@ -214,13 +239,25 @@ class DecodePool:
                     "got %r" % (height * width * 3 // 2, height, width,
                                 out.shape))
             out_w, out_h = width, height
+        elif pixfmt == PIX_DCT:
+            if out.ndim != 3:
+                raise ValueError("PIX_DCT wants (clips, frames, elems) "
+                                 "int16, got %r" % (out.shape,))
+            dct_coeffs = coeffs_from_elems(height, width, out.shape[2])
+            out_w, out_h = width, height
         else:
             raise ValueError("unknown pixfmt %r" % (pixfmt,))
         starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
-        ticket = self._lib.rnb_pool_submit_fmt(
-            self._pool, path.encode(), starts, len(clip_starts),
-            consecutive_frames, out_w, out_h, pixfmt,
-            out.ctypes.data_as(ctypes.c_char_p))
+        if pixfmt == PIX_DCT:
+            ticket = self._lib.rnb_pool_submit_dct(
+                self._pool, path.encode(), starts, len(clip_starts),
+                consecutive_frames, out_w, out_h, dct_coeffs,
+                out.ctypes.data_as(ctypes.c_void_p))
+        else:
+            ticket = self._lib.rnb_pool_submit_fmt(
+                self._pool, path.encode(), starts, len(clip_starts),
+                consecutive_frames, out_w, out_h, pixfmt,
+                out.ctypes.data_as(ctypes.c_char_p))
         if ticket <= 0:
             raise RuntimeError("native pool rejected submit for %r" % path)
         with self._pending_lock:
@@ -355,4 +392,27 @@ class NativeY4MDecoder(VideoDecoder):
             video.encode(), starts, len(clip_starts), consecutive_frames,
             width, height, PIX_YUV420,
             out.ctypes.data_as(ctypes.c_char_p)), video)
+        return out
+
+    def decode_clips_dct(self, video: str, clip_starts: List[int],
+                         consecutive_frames: int = 8,
+                         width: int = DEFAULT_WIDTH,
+                         height: int = DEFAULT_HEIGHT,
+                         coeffs=None) -> np.ndarray:
+        """Packed dequantized-coefficient rows (rnb_tpu/ops/dct.py
+        wire format) straight from the C++ entropy decoder — the
+        per-pixel IDCT/convert work never runs on the host."""
+        elems = dct_frame_elems(height, width, coeffs)
+        out = np.empty((len(clip_starts), consecutive_frames, elems),
+                       dtype=np.int16)
+        if self._use_pool and len(clip_starts) >= POOL_SPLIT_MIN_CLIPS:
+            return self._pool_fanout(video, clip_starts,
+                                     consecutive_frames, out, PIX_DCT,
+                                     width, height)
+        starts = (ctypes.c_longlong * len(clip_starts))(*clip_starts)
+        _check(self._lib.rnb_y4m_decode_clips_dct(
+            video.encode(), starts, len(clip_starts),
+            consecutive_frames, width, height,
+            coeffs_from_elems(height, width, elems),
+            out.ctypes.data_as(ctypes.c_void_p)), video)
         return out

@@ -33,8 +33,9 @@ from rnb_tpu.autotune import BatchController
 from rnb_tpu.cache import content_key
 from rnb_tpu.compilestats import SignatureTracker
 from rnb_tpu.decode import get_decoder
-from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_RGB,
-                                   PIX_YUV420, default_decode_threads,
+from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_DCT,
+                                   PIX_RGB, PIX_YUV420,
+                                   default_decode_threads,
                                    native_available)
 from rnb_tpu.faults import (FATAL, TRANSIENT, TransientDecodeError,
                             classify_error, fault_reason)
@@ -45,6 +46,7 @@ from rnb_tpu.models.r2p1d.network import (KINETICS_CLASSES,
                                           R2Plus1DClassifier,
                                           R18_LAYER_SIZES)
 from rnb_tpu.models.r2p1d.sampler import R2P1DSampler
+from rnb_tpu.ops.dct import dct_frame_elems, default_dct_coeffs
 from rnb_tpu.ops.ragged import resolve_pool_rows, segment_offsets_of
 from rnb_tpu.ops.yuv import packed_frame_bytes
 from rnb_tpu.selector import QueueSelector
@@ -149,6 +151,12 @@ def _shared_apply(start: int, end: int, num_classes: int,
                     def ingest(x, rows_valid):
                         return ragged_normalize_yuv420(
                             x, rows_valid, FRAME_HW, FRAME_HW)
+                elif pixel_path == "dct":
+                    from rnb_tpu.ops.dct import ragged_normalize_dct
+
+                    def ingest(x, rows_valid):
+                        return ragged_normalize_dct(
+                            x, rows_valid, FRAME_HW, FRAME_HW)
                 else:
                     # rgb/mid-pipeline pools arrive already normalized
                     # and masked by the producing loader's ragged
@@ -191,6 +199,12 @@ def _shared_apply(start: int, end: int, num_classes: int,
 
                 def apply(variables, x):
                     return model.apply(variables, normalize_yuv420(
+                        x, FRAME_HW, FRAME_HW), train=False)
+            elif pixel_path == "dct":
+                from rnb_tpu.ops.dct import normalize_dct
+
+                def apply(variables, x):
+                    return model.apply(variables, normalize_dct(
                         x, FRAME_HW, FRAME_HW), train=False)
             else:
                 def apply(variables, x):
@@ -382,6 +396,7 @@ class R2P1DLoader(StageModel):
                  staging_slots=None, transfer_async: bool = False,
                  fallback_decode_threads=None,
                  ragged: bool = False, ragged_pool_rows=None,
+                 dct_coeffs_per_frame=None,
                  **kwargs):
         super().__init__(device)
         import jax
@@ -396,13 +411,41 @@ class R2P1DLoader(StageModel):
         # (rnb_tpu/ops/yuv.py). The benchmark host's single core is the
         # throughput ceiling (RESULTS.md), so moving the colourspace
         # arithmetic on-device lifts end-to-end throughput directly.
-        if pixel_path not in ("rgb", "yuv420"):
-            raise ValueError("pixel_path must be 'rgb' or 'yuv420', "
-                             "got %r" % (pixel_path,))
+        # "dct": the MJPEG decode stops at entropy-decoded, dequantized
+        # DCT coefficients shipped as packed sparse int16 rows
+        # (rnb_tpu/ops/dct.py — ~0.5x the yuv420 wire bytes at the
+        # default budget); IDCT + chroma upsample + BT.601 + normalize
+        # run fused on-device ahead of conv1, deleting the host's
+        # remaining per-pixel work.
+        if pixel_path not in ("rgb", "yuv420", "dct"):
+            raise ValueError("pixel_path must be 'rgb', 'yuv420' or "
+                             "'dct', got %r" % (pixel_path,))
         # raw_output + yuv420 composes: the loader ships packed planes
         # and the mesh consumer's sharded program runs the fused yuv
         # ingest (configure the SAME pixel_path on both stages)
         self.pixel_path = pixel_path
+        self.dct_coeffs = None
+        if pixel_path == "dct":
+            if raw_output:
+                raise ValueError(
+                    "pixel_path='dct' cannot combine with raw_output: "
+                    "mesh consumers ingest raw pixel batches, not "
+                    "packed coefficient rows")
+            self.dct_coeffs = (int(dct_coeffs_per_frame)
+                               if dct_coeffs_per_frame is not None
+                               else default_dct_coeffs(FRAME_HW,
+                                                       FRAME_HW))
+            if self.dct_coeffs < 1:
+                raise ValueError("dct_coeffs_per_frame must be >= 1, "
+                                 "got %r" % (dct_coeffs_per_frame,))
+        elif dct_coeffs_per_frame is not None:
+            raise ValueError("dct_coeffs_per_frame only applies to "
+                             "pixel_path='dct'")
+        #: the wire dtype every decode/staging/transfer buffer of this
+        #: stage uses: int16 packed coefficient rows under dct, u8
+        #: pixel/plane rows otherwise
+        self._wire_dtype = (np.int16 if pixel_path == "dct"
+                            else np.uint8)
         sampler_kwargs = {}
         if num_clips_population is not None:
             sampler_kwargs["num_clips_population"] = num_clips_population
@@ -505,7 +548,8 @@ class R2P1DLoader(StageModel):
             # is ignored there (default_slots()==0) rather than
             # allocating dead slots and reporting misleading Staging:
             # telemetry. Non-native backends keep the copy fallback.
-            self.staging = StagingPool(self._staging_shapes(), slots)
+            self.staging = StagingPool(self._staging_shapes(), slots,
+                                       dtype=self._wire_dtype)
         # Device-resident decoded-clip cache + in-flight coalescing
         # (rnb_tpu.cache): opt-in per config via `cache_mb`. The cached
         # value is the padded on-device uint8 batch (post-device_put,
@@ -530,23 +574,27 @@ class R2P1DLoader(StageModel):
                 self.max_clips, self.row_buckets,
                 # ragged entries hold host row extents, bucketed ones
                 # padded device batches — the two must never alias
-                self.ragged)
+                self.ragged,
+                # the dct wire row length depends on the coefficient
+                # budget: two budgets must never alias one entry
+                self.dct_coeffs)
         self._preprocess_ragged = None
         #: jit-entry signature accounting (rnb_tpu.compilestats):
         #: distinct preprocess input signatures == executables this
         #: stage requires; frozen by the executor at window start so
         #: any later new signature surfaces as a mid-run recompile
         self.compiles = None
-        if self.raw_output or self.pixel_path == "yuv420":
-            # raw mode: consumer normalizes on its mesh. yuv420: the
-            # network stage's jit owns the whole ingest; the loader
-            # ships packed u8 — warm only the transfer path (one shape
-            # per bucket; ONE pool shape under ragged — device_put
-            # itself never compiles)
+        if self.raw_output or self.pixel_path in ("yuv420", "dct"):
+            # raw mode: consumer normalizes on its mesh. yuv420/dct:
+            # the network stage's jit owns the whole ingest; the
+            # loader ships packed u8 planes / int16 coefficient rows —
+            # warm only the transfer path (one shape per bucket; ONE
+            # pool shape under ragged — device_put itself never
+            # compiles)
             self._preprocess = None
             for rows in self._warm_shapes():
                 dummy = np.zeros(self._batch_shape(rows),
-                                 dtype=np.uint8)
+                                 dtype=self._wire_dtype)
                 for _ in range(num_warmups):
                     jax.block_until_ready(
                         jax.device_put(dummy, self._jax_device))
@@ -711,7 +759,8 @@ class R2P1DLoader(StageModel):
                 self._batch_shape(self._ship_rows(n)))
             self.staging.add_ref(slot)
             return slot.buf[:n], slot, 0
-        return np.empty(self._batch_shape(n), dtype=np.uint8), None, 0
+        return (np.empty(self._batch_shape(n), dtype=self._wire_dtype),
+                None, 0)
 
     def _release_handle_slot(self, handle) -> None:
         """Retire a handle's staging-slot reference (idempotent): its
@@ -728,6 +777,12 @@ class R2P1DLoader(StageModel):
                                             self.consecutive_frames,
                                             width=FRAME_HW,
                                             height=FRAME_HW)
+        if self.pixel_path == "dct":
+            return decoder.decode_clips_dct(video, starts,
+                                            self.consecutive_frames,
+                                            width=FRAME_HW,
+                                            height=FRAME_HW,
+                                            coeffs=self.dct_coeffs)
         return decoder.decode_clips(video, starts,
                                     self.consecutive_frames,
                                     width=FRAME_HW, height=FRAME_HW)
@@ -737,6 +792,10 @@ class R2P1DLoader(StageModel):
         if self.pixel_path == "yuv420":
             return (n, self.consecutive_frames,
                     packed_frame_bytes(FRAME_HW, FRAME_HW))
+        if self.pixel_path == "dct":
+            return (n, self.consecutive_frames,
+                    dct_frame_elems(FRAME_HW, FRAME_HW,
+                                    self.dct_coeffs))
         return (n, self.consecutive_frames, FRAME_HW, FRAME_HW, 3)
 
     def _bucket_for(self, n: int) -> int:
@@ -755,10 +814,15 @@ class R2P1DLoader(StageModel):
     @classmethod
     def output_shape_for(cls, max_clips: int = MAX_CLIPS,
                          consecutive_frames: int = CONSECUTIVE_FRAMES,
-                         pixel_path: str = "rgb", **_kwargs):
+                         pixel_path: str = "rgb",
+                         dct_coeffs_per_frame=None, **_kwargs):
         if pixel_path == "yuv420":
             return ((int(max_clips), int(consecutive_frames),
                      packed_frame_bytes(FRAME_HW, FRAME_HW)),)
+        if pixel_path == "dct":
+            return ((int(max_clips), int(consecutive_frames),
+                     dct_frame_elems(FRAME_HW, FRAME_HW,
+                                     dct_coeffs_per_frame)),)
         return ((int(max_clips), int(consecutive_frames),
                  FRAME_HW, FRAME_HW, 3),)
 
@@ -766,8 +830,11 @@ class R2P1DLoader(StageModel):
     def output_dtype_for(cls, raw_output: bool = False,
                          pixel_path: str = "rgb", **_kwargs):
         # raw mode ships the padded uint8 batch; yuv420 ships packed u8
-        # planes for the consumer's fused ingest; otherwise the jitted
-        # preprocess emits normalized bfloat16
+        # planes and dct ships packed int16 coefficient rows for the
+        # consumer's fused ingest; otherwise the jitted preprocess
+        # emits normalized bfloat16
+        if pixel_path == "dct":
+            return "int16"
         if raw_output or pixel_path == "yuv420":
             return "uint8"
         return "bfloat16"
@@ -908,8 +975,8 @@ class R2P1DLoader(StageModel):
         # path survives
         if isinstance(decoder, NativeY4MDecoder):
             out, slot, row0 = self._stage_target(n)
-            pixfmt = (PIX_YUV420 if self.pixel_path == "yuv420"
-                      else PIX_RGB)
+            pixfmt = {"yuv420": PIX_YUV420,
+                      "dct": PIX_DCT}.get(self.pixel_path, PIX_RGB)
             pool = DecodePool.shared()
             tickets = []
             try:
@@ -974,10 +1041,10 @@ class R2P1DLoader(StageModel):
             # ragged consumers mask rows >= rows_valid in-jit, so the
             # pool tail can stay uninitialized — for the dominant
             # 1-clip request that skips a pool-minus-one-row memset
-            padded = np.empty(target, dtype=np.uint8)
+            padded = np.empty(target, dtype=self._wire_dtype)
             padded[:n] = clips
         else:
-            padded = np.zeros(target, dtype=np.uint8)
+            padded = np.zeros(target, dtype=self._wire_dtype)
             padded[:n] = clips
         if cache_key is not None and self.cache is not None \
                 and self.ragged:
@@ -1582,7 +1649,8 @@ class R2P1DFusingLoader(R2P1DLoader):
                         else:
                             self.cache.insert_host(
                                 rec.key, rec.handle.out, n,
-                                self._batch_shape(self._bucket_for(n)))
+                                self._batch_shape(self._bucket_for(n)),
+                                dtype=self._wire_dtype)
         cards = []
         for rec in ok:
             cards.extend(rec.cards)
@@ -1663,7 +1731,8 @@ class R2P1DFusingLoader(R2P1DLoader):
         with hostprof.section("loader.emit_alloc"):
             # copy fallback (RNB-H007 baselined): rows [0, rows) are
             # overwritten below; only the padding tail needs zeroing
-            out = np.empty(self._batch_shape(bucket), dtype=np.uint8)
+            out = np.empty(self._batch_shape(bucket),
+                           dtype=self._wire_dtype)
         row = 0
         with hostprof.section("loader.emit_copy"):
             for rec in ok:
@@ -2036,24 +2105,29 @@ class R2P1DRunner(StageModel):
                  row_buckets=None, factored_shortcut: bool = False,
                  pixel_path: str = "rgb",
                  ragged: bool = False, ragged_pool_rows=None,
-                 ragged_chunk_rows=None, **kwargs):
+                 ragged_chunk_rows=None, dct_coeffs_per_frame=None,
+                 **kwargs):
         super().__init__(device)
         import jax
         if not (1 <= start_index <= end_index <= NUM_LAYERS):
             raise ValueError("invalid layer range [%s..%s]"
                              % (start_index, end_index))
-        if pixel_path not in ("rgb", "yuv420"):
-            raise ValueError("pixel_path must be 'rgb' or 'yuv420', "
-                             "got %r" % (pixel_path,))
-        if pixel_path == "yuv420" and start_index != 1:
-            raise ValueError("pixel_path='yuv420' fuses the ingest in "
+        if pixel_path not in ("rgb", "yuv420", "dct"):
+            raise ValueError("pixel_path must be 'rgb', 'yuv420' or "
+                             "'dct', got %r" % (pixel_path,))
+        if pixel_path in ("yuv420", "dct") and start_index != 1:
+            raise ValueError("pixel_path=%r fuses the ingest in "
                              "front of layer 1; a [%d..%d] stage "
                              "receives activations, not frames"
-                             % (start_index, end_index))
+                             % (pixel_path, start_index, end_index))
+        if dct_coeffs_per_frame is not None and pixel_path != "dct":
+            raise ValueError("dct_coeffs_per_frame only applies to "
+                             "pixel_path='dct'")
         self.start_index = int(start_index)
         self.end_index = int(end_index)
         self.max_rows = int(max_rows)
         self.pixel_path = pixel_path
+        self.dct_coeffs_per_frame = dct_coeffs_per_frame
         # Ragged row-pool dispatch (rnb_tpu.ops.ragged): the stage's
         # input is always the ONE pool shape (== the declared max row
         # axis) plus a traced rows_valid scalar — one warmup compile
@@ -2104,7 +2178,8 @@ class R2P1DRunner(StageModel):
         self._steady_shape = self.input_shape_for(
             start_index=self.start_index, max_rows=self.max_rows,
             consecutive_frames=consecutive_frames,
-            pixel_path=self.pixel_path)[0]
+            pixel_path=self.pixel_path,
+            dct_coeffs_per_frame=self.dct_coeffs_per_frame)[0]
         import jax.numpy as jnp
         warm_dtype = getattr(jnp, self.input_dtype_for(
             start_index=self.start_index, pixel_path=self.pixel_path))
@@ -2150,7 +2225,8 @@ class R2P1DRunner(StageModel):
     def input_shape_for(cls, start_index: int = 1,
                         max_rows: int = MAX_CLIPS,
                         consecutive_frames: int = CONSECUTIVE_FRAMES,
-                        pixel_path: str = "rgb", **_kwargs):
+                        pixel_path: str = "rgb",
+                        dct_coeffs_per_frame=None, **_kwargs):
         # the exact steady-state input shape warm-up compiles. The
         # temporal extent follows the pipeline's consecutive_frames
         # everywhere: at layer 1 it IS consecutive_frames; mid-pipeline
@@ -2161,6 +2237,10 @@ class R2P1DRunner(StageModel):
         if pixel_path == "yuv420":
             shape = (int(consecutive_frames),
                      packed_frame_bytes(FRAME_HW, FRAME_HW))
+        elif pixel_path == "dct":
+            shape = (int(consecutive_frames),
+                     dct_frame_elems(FRAME_HW, FRAME_HW,
+                                     dct_coeffs_per_frame))
         elif int(start_index) == 1:
             shape = ((int(consecutive_frames),)
                      + tuple(LAYER_INPUT_SHAPES[1][1:]))
@@ -2173,11 +2253,14 @@ class R2P1DRunner(StageModel):
     def input_dtype_for(cls, start_index: int = 1,
                         pixel_path: str = "rgb", **_kwargs):
         # the dtype the pipeline actually flows: packed uint8 planes
-        # under pixel_path='yuv420'; the loader's preprocess emits
-        # bfloat16 into layer 1; an upstream network stage emits
-        # float32 activations (R2Plus1DClassifier casts its output)
+        # under pixel_path='yuv420', packed int16 coefficient rows
+        # under 'dct'; the loader's preprocess emits bfloat16 into
+        # layer 1; an upstream network stage emits float32 activations
+        # (R2Plus1DClassifier casts its output)
         if pixel_path == "yuv420":
             return "uint8"
+        if pixel_path == "dct":
+            return "int16"
         return "bfloat16" if int(start_index) == 1 else "float32"
 
     @classmethod
@@ -2268,7 +2351,9 @@ class R2P1DSingleStep(StageModel):
                                factored_shortcut=kwargs.get(
                                    "factored_shortcut", False),
                                pixel_path=kwargs.get("pixel_path",
-                                                     "rgb"))
+                                                     "rgb"),
+                               dct_coeffs_per_frame=kwargs.get(
+                                   "dct_coeffs_per_frame"))
 
     def enable_trace(self, tracer, step_idx: int) -> None:
         """Forward to the embedded loader: its phase-refinement

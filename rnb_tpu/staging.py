@@ -79,13 +79,16 @@ class StagingSlot:
     live in the buffer; ``transfers`` counts handed-off-but-unconfirmed
     device transfers; ``pending_confirm`` holds device arrays whose
     transfer completion is confirmed lazily at the next acquire (the
-    double-buffering gate)."""
+    double-buffering gate). ``dtype`` follows the owning loader's wire
+    dtype (uint8 pixel/plane rows; int16 packed dct coefficient
+    rows)."""
 
-    __slots__ = ("buf", "shape", "state", "refs", "transfers",
+    __slots__ = ("buf", "shape", "dtype", "state", "refs", "transfers",
                  "pending_confirm", "tainted")
 
-    def __init__(self, shape: Tuple[int, ...]):
-        self.buf = np.empty(shape, dtype=np.uint8)
+    def __init__(self, shape: Tuple[int, ...], dtype=np.uint8):
+        self.dtype = np.dtype(dtype)
+        self.buf = np.empty(shape, dtype=self.dtype)
         self.shape = tuple(shape)
         self.state = FREE
         self.refs = 0
@@ -113,17 +116,18 @@ class StagingPool:
     """
 
     def __init__(self, shapes: Sequence[Tuple[int, ...]],
-                 slots_per_shape: int):
+                 slots_per_shape: int, dtype=np.uint8):
         if slots_per_shape < 1:
             raise ValueError("slots_per_shape must be >= 1, got %r"
                              % (slots_per_shape,))
+        self.dtype = np.dtype(dtype)
         self._lock = threading.Lock()
         self._available = threading.Condition(self._lock)
         self._slots: Dict[Tuple[int, ...], List[StagingSlot]] = {}
         for shape in shapes:
             shape = tuple(int(d) for d in shape)
             if shape not in self._slots:
-                self._slots[shape] = [StagingSlot(shape)
+                self._slots[shape] = [StagingSlot(shape, self.dtype)
                                       for _ in range(slots_per_shape)]
         self.slots_per_shape = int(slots_per_shape)
         self._error: Optional[BaseException] = None
@@ -153,7 +157,7 @@ class StagingPool:
             # the device array owns (aliases) the old buffer — replace
             # it rather than corrupt the live batch. One np.empty, no
             # copy: still cheaper than the seed alloc+memcpy path.
-            slot.buf = np.empty(slot.shape, dtype=np.uint8)
+            slot.buf = np.empty(slot.shape, dtype=slot.dtype)
             slot.tainted = False
             self.num_reallocs += 1
 
@@ -172,7 +176,7 @@ class StagingPool:
             if shape not in self._slots:
                 # shapes are pre-registered at construction; an unseen
                 # shape (e.g. a config change) gets its own sub-pool
-                self._slots[shape] = [StagingSlot(shape)
+                self._slots[shape] = [StagingSlot(shape, self.dtype)
                                       for _ in range(self.slots_per_shape)]
             slot = self._acquirable_locked(shape)
             if slot is None:

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""``make dct``: same-seed yuv420-vs-dct A/B validating the DCT-domain
+ingest end-to-end.
+
+Generates a tiny 112x112 MJPEG dataset (the dct wire format has no
+host resize — coefficients ship at source geometry), then:
+
+1. **Logit parity** through a real reduced R(2+1)D stage: one video
+   decoded through the yuv420 pixel path (packed planes + fused
+   on-device colourspace) and through the dct path (packed dequantized
+   coefficients + fused on-device IDCT/upsample/convert/normalize)
+   must agree — same argmax, logits within float-IDCT rounding.
+2. **A/B runs** (``run_benchmark``, same seed) of a ragged fusing
+   pipeline per pixel path, asserting both arms terminate cleanly and
+   pass ``parse_utils --check``, the dct network stage compiles
+   exactly ONE signature with none added mid-run, and the dct arm's
+   host->device bytes/frame are <= 0.5x the yuv420 arm's — measured
+   from the staging-slot ledger when the native decoder stages
+   zero-copy, else from the declared wire shapes.
+
+Exit 0 = the wire-byte claim and the numerics contract both hold.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: JPEG quality of the demo dataset: high-entropy content at q90+ can
+#: exceed the default half-of-yuv420 coefficient budget (README
+#: "DCT-domain ingest" — when yuv420 stays preferable); q75 gradients
+#: fit with ~15% headroom
+DEMO_QUALITY = 75
+
+
+def _make_dataset(root: str, videos: int = 6, frames: int = 24) -> None:
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from make_dataset import synth_frames
+
+    from rnb_tpu.decode import write_mjpeg
+    label = os.path.join(root, "label000")
+    os.makedirs(label, exist_ok=True)
+    for vi in range(videos):
+        write_mjpeg(os.path.join(label, "video%04d.mjpg" % vi),
+                    synth_frames(frames, 112, 112, seed=[17, 0, vi]),
+                    quality=DEMO_QUALITY)
+
+
+def _config(pixel_path: str) -> dict:
+    return {
+        "_comment": "make-dct demo: ragged fusing pipeline, %s arm"
+                    % pixel_path,
+        "video_path_iterator":
+            "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+        "ragged": {"enabled": True, "pool_rows": 3},
+        "pipeline": [
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DFusingLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 20,
+             "max_clips": 3, "consecutive_frames": 2,
+             "num_clips_population": [1, 2, 3], "weights": [2, 1, 1],
+             "row_buckets": [2, 3], "fuse": 2,
+             "pixel_path": pixel_path, "num_warmups": 1},
+            {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+             "queue_groups": [{"devices": [1], "in_queue": 0}],
+             "start_index": 1, "end_index": 5, "num_classes": 8,
+             "layer_sizes": [1, 1, 1, 1], "max_rows": 3,
+             "row_buckets": [2, 3], "consecutive_frames": 2,
+             "pixel_path": pixel_path, "ragged_chunk_rows": 1,
+             "num_warmups": 1}],
+    }
+
+
+def _logit_parity(video: str, failures: list) -> None:
+    import numpy as np
+    import jax
+
+    from rnb_tpu.models.r2p1d.model import R2P1DLoader, R2P1DRunner
+    from rnb_tpu.telemetry import TimeCard
+    dev = jax.devices()[0]
+    fixed = dict(num_clips_population=[2], weights=[1], max_clips=2,
+                 num_warmups=0, consecutive_frames=2)
+    net = dict(start_index=1, end_index=5, num_warmups=0,
+               layer_sizes=(1, 1, 1, 1), max_rows=2, num_classes=8,
+               consecutive_frames=2)
+    logits = {}
+    for arm in ("yuv420", "dct"):
+        loader = R2P1DLoader(dev, pixel_path=arm, **fixed)
+        runner = R2P1DRunner(dev, pixel_path=arm, **net)
+        (pb,), _, tc = loader(None, video, TimeCard(0))
+        (lg,), _, _ = runner((pb,), None, tc)
+        logits[arm] = np.asarray(lg.data, np.float32)
+    a, b = logits["dct"], logits["yuv420"]
+    if not np.array_equal(a.argmax(-1), b.argmax(-1)):
+        failures.append("dct vs yuv420 argmax diverged: %s vs %s"
+                        % (a.argmax(-1), b.argmax(-1)))
+    tol = 0.05 * float(np.abs(b).max())
+    if float(np.abs(a - b).max()) > tol:
+        failures.append("dct vs yuv420 logits differ by %.4f (tol "
+                        "%.4f) — the on-device IDCT drifted past "
+                        "float rounding" % (np.abs(a - b).max(), tol))
+    print("logit parity: max |dct - yuv420| = %.5f (argmax equal)"
+          % float(np.abs(a - b).max()))
+
+
+def _wire_bytes_per_frame(res, pixel_path: str) -> float:
+    """Measured bytes of one frame on the host->device wire: the
+    staging ledger's per-slot bytes when the native decoder staged
+    zero-copy, else the declared wire shape."""
+    if getattr(res, "staging_slots", 0):
+        # slots are (pool_rows, frames, per_frame) wire buffers
+        per_slot = res.staging_slot_bytes / res.staging_slots
+        return per_slot / (3 * 2)  # pool_rows=3, consecutive_frames=2
+    from rnb_tpu.ops.dct import dct_frame_elems
+    from rnb_tpu.ops.yuv import packed_frame_bytes
+    return (dct_frame_elems(112, 112) * 2 if pixel_path == "dct"
+            else packed_frame_bytes(112, 112))
+
+
+def main() -> int:
+    from rnb_tpu.benchmark import run_benchmark
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    failures = []
+    results = {}
+    with tempfile.TemporaryDirectory(prefix="rnb-dct-demo-") as tmp:
+        data_root = os.path.join(tmp, "data")
+        _make_dataset(data_root)
+        os.environ["RNB_TPU_DATA_ROOT"] = data_root
+        _logit_parity(os.path.join(data_root, "label000",
+                                   "video0000.mjpg"), failures)
+        for arm in ("yuv420", "dct"):
+            cfg_path = os.path.join(tmp, "dct-demo-%s.json" % arm)
+            with open(cfg_path, "w") as f:
+                json.dump(_config(arm), f)
+            res = run_benchmark(cfg_path, mean_interval_ms=0,
+                                num_videos=8, queue_size=64,
+                                log_base=os.path.join(REPO, "logs"),
+                                print_progress=False, seed=11)
+            results[arm] = res
+            if res.termination_flag != 0:
+                failures.append("%s arm terminated with flag %d"
+                                % (arm, res.termination_flag))
+                continue
+            if res.num_failed:
+                failures.append("%s arm dead-lettered %d request(s)"
+                                % (arm, res.num_failed))
+            for problem in parse_utils.check_job(res.log_dir):
+                failures.append("%s --check: %s" % (arm, problem))
+
+    yuv, dct = results.get("yuv420"), results.get("dct")
+    if yuv is None or dct is None:
+        for failure in failures:
+            print("FAIL: %s" % failure)
+        return 1
+    net = dct.compile_signatures.get("step1", {})
+    if net.get("warmup") != 1 or net.get("steady_new", 0) != 0:
+        failures.append("dct net stage must compile exactly one "
+                        "signature (got %s)" % (net,))
+    dct_bpf = _wire_bytes_per_frame(dct, "dct")
+    yuv_bpf = _wire_bytes_per_frame(yuv, "yuv420")
+    ratio = dct_bpf / yuv_bpf
+    print("wire bytes/frame: dct=%.0f yuv420=%.0f ratio=%.3f "
+          "(staging-measured=%s)"
+          % (dct_bpf, yuv_bpf, ratio, bool(dct.staging_slots)))
+    if ratio > 0.5:
+        failures.append("dct arm ships %.3fx the yuv420 wire bytes "
+                        "per frame — the headline requires <= 0.5x"
+                        % ratio)
+    print("throughput: dct %.3f vps, yuv420 %.3f vps"
+          % (dct.throughput_vps, yuv.throughput_vps))
+
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("OK — DCT-domain ingest: one compiled shape, %.3fx the "
+          "yuv420 wire bytes, logits parity through the fused "
+          "on-device IDCT" % ratio)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,460 @@
+"""The dct pixel path: packed dequantized-coefficient decode backends
++ the fused on-device IDCT/upsample/convert/normalize ingest.
+
+Contract under test (rnb_tpu/ops/dct.py, rnb_tpu/decode/jpeg_dct.py):
+  * the wire format round-trips, and the default budget is half the
+    packed-yuv420 frame bytes;
+  * the Pallas kernel body (interpret=True) is BIT-identical to the
+    masked jnp twin tier-1 exercises, pad rows exactly zero;
+  * the native C++ coefficient decode is bit-exact with the
+    independent pure-Python entropy decoder (the fallback oracle);
+  * reconstructed pixels match the yuv420 pixel path within float-IDCT
+    rounding, and reduced R(2+1)D logits agree across
+    dct / yuv420 / rgb on the same video;
+  * ragged and bucketed dct dispatches are bit-identical on valid
+    rows with exactly ONE compiled signature;
+  * a mid-pool decode failure on the dct path is contained without
+    poisoning pool-mates.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from rnb_tpu.decode import (MjpegPILDecoder, SyntheticDecoder,
+                            Y4MDecoder, write_mjpeg, write_y4m)
+from rnb_tpu.faults import CorruptVideoError
+from rnb_tpu.ops.dct import (coeffs_from_elems, dct_frame_elems,
+                             dct_rows_to_rgb_numpy, default_dct_coeffs,
+                             num_dct_blocks, pack_frame_dct,
+                             ragged_normalize_dct,
+                             unpack_frame_dct_numpy)
+from rnb_tpu.ops.yuv import packed_frame_bytes, yuv420_to_rgb_numpy
+from rnb_tpu.telemetry import TimeCard
+
+LS = (1, 1, 1, 1)  # minimal layer sizes: fast compile, full topology
+
+
+def _smooth_frames(n=8, hw=112, seed=5):
+    """Real-video-like moving gradients (JPEG-sparse spectrum, smooth
+    chroma — the content class the bytes-per-frame headline assumes;
+    pure noise would blow the coefficient budget by design)."""
+    rng = np.random.default_rng(seed)
+    phase = rng.uniform(0, 2 * np.pi, size=3)
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32)
+    t = np.arange(n, dtype=np.float32)[:, None, None]
+    frames = np.empty((n, hw, hw, 3), np.uint8)
+    for c in range(3):
+        frames[..., c] = (127.5 * (1 + np.sin(
+            2 * np.pi * (yy / hw + xx / hw) + phase[c] + 0.1 * t))
+        ).astype(np.uint8)
+    return frames
+
+
+def _mjpg(tmp_path, name="v.mjpg", n=12, quality=85, seed=5):
+    path = os.path.join(str(tmp_path), name)
+    write_mjpeg(path, _smooth_frames(n, seed=seed), quality=quality)
+    return path
+
+
+def _rand_wire(rng, rows, frames, hw=32, max_per_block=5):
+    """A random sparse coefficient pool (well-formed wire rows)."""
+    nb = num_dct_blocks(hw, hw)
+    elems = dct_frame_elems(hw, hw)
+    pool = np.zeros((rows, frames, elems), np.int16)
+    for r in range(rows):
+        for f in range(frames):
+            zz = np.zeros((nb, 64), np.int16)
+            for b in range(nb):
+                k = rng.integers(1, max_per_block + 1)
+                pos = np.sort(rng.choice(64, size=k, replace=False))
+                zz[b, pos] = rng.integers(-900, 900, k).astype(np.int16)
+            pool[r, f] = pack_frame_dct(zz, hw, hw)
+    return pool
+
+
+# -- wire format ------------------------------------------------------
+
+def test_wire_format_roundtrip_and_default_budget():
+    hw = 112
+    assert num_dct_blocks(hw, hw) == 294
+    elems = dct_frame_elems(hw, hw)
+    # the headline: a packed int16 frame is at most HALF the packed
+    # yuv420 frame at the default budget
+    assert elems * 2 <= packed_frame_bytes(hw, hw) // 2
+    assert coeffs_from_elems(hw, hw, elems) == default_dct_coeffs(hw, hw)
+    rng = np.random.default_rng(0)
+    zz = np.zeros((294, 64), np.int16)
+    for b in range(294):
+        pos = np.sort(rng.choice(64, size=4, replace=False))
+        zz[b, pos] = rng.integers(-2000, 2000, 4).astype(np.int16)
+    wire = pack_frame_dct(zz, hw, hw)
+    np.testing.assert_array_equal(unpack_frame_dct_numpy(wire, hw, hw),
+                                  zz)
+    with pytest.raises(ValueError):
+        pack_frame_dct(zz, hw, hw, coeffs=100)  # over-budget spectrum
+    with pytest.raises(ValueError):
+        num_dct_blocks(100, 112)  # not divisible by 16
+    with pytest.raises(ValueError):
+        coeffs_from_elems(hw, hw, 295)  # odd remainder
+
+
+# -- the fused primitive ----------------------------------------------
+
+def test_pallas_interpret_matches_jnp_twin_bit_exact():
+    # the TPU kernel body itself (grid skip via pl.when, scalar-
+    # prefetched rows_valid) runs under interpret=True and must be
+    # bit-identical to the masked jnp twin tier-1 exercises
+    import jax.numpy as jnp
+    pool = _rand_wire(np.random.default_rng(1), rows=4, frames=2)
+    for valid in (0, 1, 3, 4):
+        a = np.asarray(ragged_normalize_dct(
+            jnp.asarray(pool), valid, 32, 32, dtype=jnp.float32))
+        b = np.asarray(ragged_normalize_dct(
+            jnp.asarray(pool), valid, 32, 32, dtype=jnp.float32,
+            interpret=True))
+        assert np.array_equal(a, b), valid
+        assert not a[valid:].any()
+        assert a.shape == (4, 2, 32, 32, 3)
+
+
+def test_unpack_is_garbage_tolerant():
+    # an uninitialized ragged pool tail must never trap or corrupt
+    # valid rows: absurd counts/positions clamp/drop deterministically
+    import jax.numpy as jnp
+    pool = _rand_wire(np.random.default_rng(2), rows=3, frames=1)
+    garbage = pool.copy()
+    garbage[1:] = np.random.default_rng(3).integers(
+        -32768, 32768, garbage[1:].shape).astype(np.int16)
+    a = np.asarray(ragged_normalize_dct(
+        jnp.asarray(pool), 1, 32, 32, dtype=jnp.float32))
+    b = np.asarray(ragged_normalize_dct(
+        jnp.asarray(garbage), 1, 32, 32, dtype=jnp.float32))
+    assert np.array_equal(a[:1], b[:1])
+    assert not b[1:].any()
+    assert np.isfinite(b).all()
+
+
+def test_conversion_matches_pixel_path_within_idct_rounding(tmp_path):
+    """The on-device direct-basis IDCT and the host AAN IDCT are two
+    float implementations of one transform: reconstructed u8 frames
+    from the SAME JPEG must agree within 1 LSB (round boundaries)
+    against the yuv420 pixel path."""
+    import jax.numpy as jnp
+    from rnb_tpu.ops.dct import normalize_dct
+    path = _mjpg(tmp_path)
+    dec = MjpegPILDecoder()
+    wire = dec.decode_clips_dct(path, [0], 4, width=112, height=112)
+    # the pure-numpy oracle first
+    rgb_dct = dct_rows_to_rgb_numpy(wire, 112, 112)
+    packed = dec.decode_clips_yuv(path, [0], 4, width=112, height=112)
+    rgb_yuv = yuv420_to_rgb_numpy(packed, 112, 112)
+    # PIL's decode_clips_yuv resamples chroma AFTER libjpeg's triangle
+    # upsample, so allow its known few-LSB spread (same bound class as
+    # tests/test_mjpeg.py's chroma tests); the tight <=1 LSB claim is
+    # asserted against the native AAN decoder below, where both sides
+    # read the STORED chroma samples
+    diff = np.abs(rgb_dct.astype(int) - rgb_yuv.astype(int))
+    assert np.percentile(diff, 99) <= 16
+    assert diff.max() <= 32
+    # the jittable twin agrees with its numpy oracle within 1 u8 LSB
+    out = np.asarray(normalize_dct(jnp.asarray(wire), 112, 112,
+                                   dtype=jnp.float32))
+    out_u8 = (out * 255.0 + 255.0) / 2.0
+    assert np.abs(out_u8 - rgb_dct.astype(np.float32)).max() <= 1.0
+
+
+# -- decode backends --------------------------------------------------
+
+def test_synthetic_dct_deterministic_and_well_formed():
+    dec = SyntheticDecoder()
+    a = dec.decode_clips_dct("synth://v1", [0, 10], 4, 112, 112)
+    b = dec.decode_clips_dct("synth://v1", [0, 10], 4, 112, 112)
+    assert a.shape == (2, 4, dct_frame_elems(112, 112))
+    assert a.dtype == np.int16
+    np.testing.assert_array_equal(a, b)
+    c = dec.decode_clips_dct("synth://v2", [0, 10], 4, 112, 112)
+    assert not np.array_equal(a, c)
+    # rows are valid wire: counts sum within budget, roundtrip clean
+    nb = num_dct_blocks(112, 112)
+    counts = a[0, 0, :nb]
+    assert (counts >= 1).all()
+    assert counts.sum() <= default_dct_coeffs(112, 112)
+    unpack_frame_dct_numpy(a[0, 0], 112, 112)
+
+
+def test_y4m_rejects_dct_as_classified_permanent(tmp_path):
+    path = os.path.join(str(tmp_path), "v.y4m")
+    write_y4m(path, _smooth_frames(4))
+    with pytest.raises(CorruptVideoError):
+        Y4MDecoder().decode_clips_dct(path, [0], 2, 112, 112)
+
+
+def test_pil_dct_geometry_and_budget_rejections(tmp_path):
+    dec = MjpegPILDecoder()
+    path = _mjpg(tmp_path)
+    with pytest.raises(CorruptVideoError):
+        # no resize exists in the coefficient domain
+        dec.decode_clips_dct(path, [0], 1, width=96, height=96)
+    with pytest.raises(CorruptVideoError):
+        # over-budget spectrum is permanent, not silently truncated
+        dec.decode_clips_dct(path, [0], 1, width=112, height=112,
+                             coeffs=50)
+
+
+needs_native = pytest.mark.skipif(
+    not __import__("rnb_tpu.decode.native",
+                   fromlist=["native_available"]).native_available(),
+    reason="native library not built")
+
+
+@needs_native
+def test_native_matches_python_oracle_bit_exact(tmp_path):
+    """The C++ entropy decoder and the independent pure-Python parser
+    must produce IDENTICAL dequantized coefficients — the oracle
+    parity that lets tier-1 trust either backend on the dct path."""
+    from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder,
+                                       PIX_DCT)
+    path = _mjpg(tmp_path, n=10)
+    nd = NativeY4MDecoder(use_pool=False)
+    a = nd.decode_clips_dct(path, [0, 3, 8], 3, width=112, height=112)
+    b = MjpegPILDecoder().decode_clips_dct(path, [0, 3, 8], 3,
+                                           width=112, height=112)
+    np.testing.assert_array_equal(a, b)
+    # the pool path writes the same bytes into a caller buffer
+    out = np.empty_like(a)
+    pool = DecodePool(num_threads=2)
+    try:
+        t = pool.submit_into(path, [0, 3, 8], 3, out, pixfmt=PIX_DCT,
+                             width=112, height=112)
+        pool.wait(t, path)
+    finally:
+        pool.close()
+    np.testing.assert_array_equal(out, a)
+
+
+@needs_native
+def test_reconstruction_within_one_lsb_of_native_pixels(tmp_path):
+    """Against the native backend both pipelines read the SAME stored
+    chroma samples, so the only difference is AAN-float vs
+    direct-basis-float IDCT rounding: a plane sample can round 1 LSB
+    apart at a .5 boundary, which the BT.601 matrix can stretch to 2
+    RGB LSB — and nothing more."""
+    from rnb_tpu.decode.native import NativeY4MDecoder
+    path = _mjpg(tmp_path, n=8, seed=13)
+    nd = NativeY4MDecoder(use_pool=False)
+    wire = nd.decode_clips_dct(path, [0], 4, width=112, height=112)
+    rgb_dct = dct_rows_to_rgb_numpy(wire, 112, 112)
+    packed = nd.decode_clips_yuv(path, [0], 4, width=112, height=112)
+    rgb_yuv = yuv420_to_rgb_numpy(packed, 112, 112)
+    diff = np.abs(rgb_dct.astype(int) - rgb_yuv.astype(int))
+    assert diff.max() <= 2
+    assert (diff == 0).mean() >= 0.99
+
+
+@needs_native
+def test_native_dct_classified_errors(tmp_path):
+    from rnb_tpu.decode.native import NativeY4MDecoder
+    nd = NativeY4MDecoder(use_pool=False)
+    path = _mjpg(tmp_path)
+    with pytest.raises(CorruptVideoError):
+        nd.decode_clips_dct(path, [0], 1, width=112, height=112,
+                            coeffs=50)  # over budget
+    y4m = os.path.join(str(tmp_path), "v.y4m")
+    write_y4m(y4m, _smooth_frames(4))
+    with pytest.raises(CorruptVideoError):
+        nd.decode_clips_dct(y4m, [0], 1, width=112, height=112)
+
+
+# -- stage wiring -----------------------------------------------------
+
+def test_loader_runner_declarations():
+    from rnb_tpu.models.r2p1d.model import R2P1DLoader, R2P1DRunner
+    elems = dct_frame_elems(112, 112)
+    assert R2P1DLoader.output_shape_for(
+        max_clips=15, consecutive_frames=8,
+        pixel_path="dct") == ((15, 8, elems),)
+    assert R2P1DLoader.output_dtype_for(pixel_path="dct") == "int16"
+    assert R2P1DRunner.input_shape_for(
+        max_rows=15, consecutive_frames=8,
+        pixel_path="dct") == ((15, 8, elems),)
+    assert R2P1DRunner.input_dtype_for(pixel_path="dct") == "int16"
+    custom = dct_frame_elems(112, 112, 1000)
+    assert R2P1DLoader.output_shape_for(
+        max_clips=2, consecutive_frames=2, pixel_path="dct",
+        dct_coeffs_per_frame=1000) == ((2, 2, custom),)
+
+
+def test_stage_validation_rejections():
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DLoader, R2P1DRunner
+    dev = jax.devices()[0]
+    with pytest.raises(ValueError):
+        R2P1DLoader(dev, pixel_path="dct", raw_output=True,
+                    num_warmups=0)
+    with pytest.raises(ValueError):
+        R2P1DLoader(dev, pixel_path="rgb", dct_coeffs_per_frame=100,
+                    num_warmups=0)
+    with pytest.raises(ValueError):
+        R2P1DRunner(dev, start_index=2, end_index=5, num_warmups=0,
+                    layer_sizes=LS, pixel_path="dct")
+    with pytest.raises(ValueError):
+        R2P1DRunner(dev, start_index=1, end_index=5, num_warmups=0,
+                    layer_sizes=LS, pixel_path="rgb",
+                    dct_coeffs_per_frame=100)
+
+
+def test_golden_logit_parity_dct_vs_yuv_vs_rgb(tmp_path):
+    """The headline numerics claim: the same video through all three
+    pixel paths lands on the same prediction through a real reduced
+    R(2+1)D stage, with dct-vs-yuv420 logits inside float-IDCT
+    rounding and both inside the documented chroma tolerance of the
+    rgb path."""
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DLoader, R2P1DRunner
+    path = _mjpg(tmp_path, n=30, seed=11)
+    dev = jax.devices()[0]
+    fixed = dict(num_clips_population=[2], weights=[1], max_clips=2,
+                 num_warmups=0, consecutive_frames=4)
+    net = dict(start_index=1, end_index=5, num_warmups=0,
+               layer_sizes=LS, max_rows=2, num_classes=16,
+               consecutive_frames=4)
+    logits = {}
+    for arm in ("rgb", "yuv420", "dct"):
+        loader = R2P1DLoader(dev, pixel_path=arm, **fixed)
+        runner = R2P1DRunner(dev, pixel_path=arm, **net)
+        (pb,), _, tc = loader(None, path, TimeCard(0))
+        if arm == "dct":
+            assert pb.data.shape == (2, 4, dct_frame_elems(112, 112))
+            assert str(pb.data.dtype) == "int16"
+        (lg,), _, _ = runner((pb,), None, tc)
+        logits[arm] = np.asarray(lg.data, np.float32)
+    ref = logits["yuv420"]
+    assert np.array_equal(logits["dct"].argmax(-1), ref.argmax(-1))
+    # dct vs yuv420: same chroma semantics, only float-IDCT rounding
+    np.testing.assert_allclose(logits["dct"], ref,
+                               atol=0.02 * np.abs(ref).max())
+    # vs rgb: the documented <=1-chroma-pixel pixel-path tolerance
+    np.testing.assert_allclose(logits["dct"], logits["rgb"],
+                               atol=0.05 * np.abs(logits["rgb"]).max())
+
+
+def test_ragged_bucketed_dct_bit_parity_one_signature(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from rnb_tpu.models.r2p1d.model import R2P1DRunner
+    from rnb_tpu.stage import PaddedBatch, RaggedBatch
+    dev = jax.devices()[0]
+    net = dict(start_index=1, end_index=5, num_classes=8,
+               layer_sizes=LS, max_rows=4, consecutive_frames=2,
+               num_warmups=1, pixel_path="dct")
+    bucketed = R2P1DRunner(dev, **net)
+    ragged = R2P1DRunner(dev, ragged=True, ragged_pool_rows=4,
+                         ragged_chunk_rows=2, **net)
+    pool = SyntheticDecoder().decode_clips_dct(
+        "synth://parity", [0, 8, 16, 24], 2, 112, 112)
+    for valid in (1, 3, 4):
+        masked = pool.copy()
+        masked[valid:] = 0  # bucketed pads are zero wire rows
+        (rg,), _, _ = ragged(
+            (RaggedBatch(jnp.asarray(pool), valid, (0, valid)),),
+            None, TimeCard(0))
+        (bk,), _, _ = bucketed(
+            (PaddedBatch(jnp.asarray(masked), valid),), None,
+            TimeCard(1))
+        assert np.array_equal(np.asarray(rg.data)[:valid],
+                              np.asarray(bk.data)[:valid]), valid
+    ragged.compiles.freeze()
+    ragged((RaggedBatch(jnp.asarray(pool), 2, (0, 2)),), None,
+           TimeCard(2))
+    snap = ragged.compiles.snapshot()
+    assert snap["warmup"] == 1 and snap["steady_new"] == 0
+
+
+def test_fusing_loader_dct_pool_and_contained_failure(tmp_path):
+    """The dct path through the fusing loader's ragged pool: good
+    requests fuse into one int16 pool emission; a mid-pool permanent
+    decode failure (an over-budget frame) is contained via
+    take_failed() without poisoning pool-mates, and the shipped
+    segment table still partitions the surviving rows."""
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader
+    from rnb_tpu.stage import RaggedBatch
+    good = [_mjpg(tmp_path, "g%d.mjpg" % i, n=10, seed=20 + i)
+            for i in range(3)]
+    # same geometry, but pure-noise frames at q95: a spectrum far past
+    # the default budget — a real over-budget permanent failure
+    noisy = np.random.default_rng(9).integers(
+        0, 256, (6, 112, 112, 3), np.uint8)
+    bad = os.path.join(str(tmp_path), "bad.mjpg")
+    write_mjpeg(bad, noisy, quality=95)
+    loader = R2P1DFusingLoader(
+        jax.devices()[0], fuse=4, max_hold_ms=10000.0, depth=50,
+        pixel_path="dct", ragged=True, max_clips=4,
+        consecutive_frames=2, num_clips_population=[1], weights=[1],
+        num_warmups=0)
+    emitted = []
+    cards = [TimeCard(i) for i in range(4)]
+    for card, p in zip(cards, [good[0], good[1], bad, good[2]]):
+        out = loader(None, p, card)
+        if out[2] is not None:
+            emitted.append(out)
+    while True:
+        out = loader.flush()
+        if out is None:
+            break
+        emitted.append(out)
+    failed = loader.take_failed()
+    assert [tc.id for tc, _ in failed] == [2]
+    assert failed[0][1] == "corrupt-video"
+    survivors = sorted(tc.id for _, _, tcl in emitted
+                       for tc in tcl.time_cards)
+    assert survivors == [0, 1, 3]
+    for (pb,), _, tcl in emitted:
+        assert isinstance(pb, RaggedBatch)
+        assert str(pb.data.dtype) == "int16"
+        assert pb.data.shape[0] == 4  # the one pool shape
+        assert pb.segment_offsets[-1] == pb.valid
+        assert pb.num_segments == len(tcl)
+
+
+def test_dct_cache_rows_roundtrip(tmp_path):
+    """Ragged clip-cache entries on the dct path are host int16 row
+    extents; a hit fills pool rows bit-identically to the decode it
+    skipped."""
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DFusingLoader
+    path = _mjpg(tmp_path, n=10, seed=31)
+    loader = R2P1DFusingLoader(
+        jax.devices()[0], fuse=1, max_hold_ms=10000.0, depth=50,
+        pixel_path="dct", ragged=True, cache_mb=16, max_clips=2,
+        consecutive_frames=2, num_clips_population=[1], weights=[1],
+        num_warmups=0)
+    emitted = []
+    out = loader(None, path, TimeCard(0))
+    if out[2] is not None:
+        emitted.append(out)
+    while True:
+        o = loader.flush()
+        if o is None:
+            break
+        emitted.append(o)
+    assert loader.cache.snapshot()["inserts"] == 1
+    first = np.asarray(emitted[0][0][0].data)
+    assert first.dtype == np.int16
+    hit_card = TimeCard(1)
+    out = loader(None, path, hit_card)
+    if out[2] is None:
+        emitted2 = []
+        while True:
+            o = loader.flush()
+            if o is None:
+                break
+            emitted2.append(o)
+        out = emitted2[0]
+    assert hit_card.cache_hit is True
+    valid = out[0][0].valid
+    np.testing.assert_array_equal(np.asarray(out[0][0].data)[:valid],
+                                  first[:valid])

@@ -39,6 +39,7 @@ GRAPH_CASES = [
     ("bad_g006_autotune.json", "RNB-G006"),
     ("bad_g007_cache.json", "RNB-G007"),
     ("bad_g008_dtype.json", "RNB-G008"),
+    ("bad_g008_dct.json", "RNB-G008"),
     ("bad_g009_ragged.json", "RNB-G009"),
 ]
 
@@ -54,6 +55,18 @@ def test_good_autotune_fixture_is_clean():
     # in-warmed-set bucket restriction passes RNB-G006
     from rnb_tpu.analysis.graph import check_config
     assert check_config(_fixture("good_autotune.json")) == []
+
+
+def test_good_dct_fixture_is_clean():
+    # pixel_path "dct": the checker derives the loader's packed
+    # coefficient row shape/dtype ((15, 8, nb + 2*C), int16) from the
+    # stage classmethods and matches it against the runner's dct
+    # ingest declaration — no RNB-G001/G003/G005/G008, and
+    # dct_coeffs_per_frame is a consumed constructor key on both
+    # stages
+    from rnb_tpu.analysis.graph import check_config
+    findings = check_config(_fixture("good_dct.json"))
+    assert findings == [], [f.render() for f in findings]
 
 
 def test_good_ragged_fixture_is_clean():

@@ -161,6 +161,55 @@ def test_metrics_arm_ships_executed_with_overhead_in_the_noise():
         "flusher/bridge before re-executing the row" % ratio)
 
 
+def test_dct_arm_ships_executed_with_half_the_wire_bytes():
+    """The DCT-domain ingest headline cell (PR 12) must land in BOTH
+    configs/ and the matrix with an ok execution row, must be the
+    same topology as rnb-fused-yuv-ragged differing by the pixel path
+    alone, must declare wire rows at <= HALF the yuv420 arm's
+    bytes/frame (the byte headline, computed from the stages' own
+    declarations), and the committed pair must back the 'no slower'
+    claim within host noise (>= 0.9x — `make dct` asserts the strict
+    byte bound and logit parity end-to-end)."""
+    rel = "configs/rnb-fused-dct-ragged.json"
+    base = "configs/rnb-fused-yuv-ragged.json"
+    path = os.path.join(REPO, rel)
+    assert os.path.exists(path), rel
+    from rnb_tpu.config import load_config
+    from rnb_tpu.utils.class_utils import load_class
+    cfg = load_config(path)
+    base_cfg = load_config(os.path.join(REPO, base))
+    assert [s.model for s in cfg.steps] \
+        == [s.model for s in base_cfg.steps]
+    assert cfg.ragged == base_cfg.ragged
+    kw = cfg.steps[0].kwargs_for_group(0)
+    base_kw = base_cfg.steps[0].kwargs_for_group(0)
+    assert kw["pixel_path"] == "dct"
+    assert base_kw["pixel_path"] == "yuv420"
+    # the wire-byte headline, from the loader's own declarations
+    loader_cls = load_class(cfg.steps[0].model)
+    dct_shape = loader_cls.output_shape_for(**kw)[0]
+    yuv_shape = loader_cls.output_shape_for(**base_kw)[0]
+    dct_bytes = dct_shape[-1] * 2   # int16 coefficient rows
+    yuv_bytes = yuv_shape[-1]       # u8 packed planes
+    assert loader_cls.output_dtype_for(**kw) == "int16"
+    assert dct_bytes * 2 <= yuv_bytes, (
+        "the dct wire row (%d B/frame) must stay <= half the yuv420 "
+        "row (%d B/frame)" % (dct_bytes, yuv_bytes))
+    with open(ARTIFACT) as f:
+        rows = {r["config"]: r for r in json.load(f)["configs"]}
+    assert rel in rows and rows[rel].get("ok"), (
+        "the dct arm has no ok execution row — run "
+        "scripts/run_shipped_configs.py --only "
+        "'rnb-fused-dct-ragged.json'")
+    ratio = rows[rel]["videos_per_sec"] / rows[base]["videos_per_sec"]
+    assert ratio >= 0.9, (
+        "dct arm runs at %.2fx the yuv420 ragged baseline — the "
+        "fused on-device ingest should be throughput-neutral on the "
+        "CPU harness (and a win on real TPUs, where the wire is the "
+        "bottleneck); profile the unpack/IDCT before re-executing "
+        "the row" % ratio)
+
+
 def test_every_executed_config_is_still_shipped():
     """The reverse direction: MULTICHIP_CONFIGS.json and configs/ stay
     in sync BOTH ways. A row for a config that no longer ships is a
