@@ -3,7 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace ragged multichip chaos metrics dct
+.PHONY: lint test native stamps trace ragged multichip chaos metrics dct \
+	devobs benchdiff
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -70,6 +71,24 @@ metrics:
 # arm, and parse_utils --check green on both arms.
 dct:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/dct_demo.py
+
+# Device observability gate (README "Device observability"): a
+# reduced-geometry r2p1d run with trace+metrics+devobs on, asserting
+# one merged Perfetto file with >= 1 device track flow-linked to
+# model_call spans, the Compute: line cross-footing bench.py's MFU to
+# the digit, Memory: owner rows footing to the ledger total with the
+# watermark firing and the live-buffer reconcile passing, bounded
+# forced-capture artifacts, parse_utils --check green — plus a
+# devobs-off arm proving byte-stable logs.
+devobs:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/devobs_demo.py
+
+# Perf-trajectory check: diff MULTICHIP_CONFIGS.json against the
+# committed MULTICHIP_BASELINE.json floor with a per-cell tolerance;
+# non-zero exit on any regression (ratify a reviewed new floor with
+# `python scripts/bench_diff.py --update`).
+benchdiff:
+	$(PYTHON) scripts/bench_diff.py
 
 native:
 	$(MAKE) -C native

@@ -296,52 +296,22 @@ def _ensure_dataset(repo_dir: str):
 
 
 def _config_stage_views(config: dict):
-    """Yield (step, [merged kwargs per queue_group]) with group keys
-    overriding step keys — mirroring the runtime's kwargs_for_group, so
-    the evidence extractors below see the same semantics the stage
-    constructors do (a group-level sync_preds/layer_sizes override must
-    not be invisible to the published evidence)."""
-    for step in config.get("pipeline", []):
-        groups = step.get("queue_groups") or [{}]
-        views = []
-        for group in groups:
-            merged = dict(step)
-            merged.update(group)
-            views.append(merged)
-        yield step, views
+    """Shared with the devobs plane (rnb_tpu.devobs) — one merged-view
+    rule so the published evidence and the runtime Compute:/Memory:
+    accounting can never disagree on what a stage was configured as."""
+    from rnb_tpu.devobs import config_stage_views
+    return config_stage_views(config)
 
 
 def _flops_per_clip_for_config(config: dict) -> float:
     """Analytic conv+dense FLOPs one clip costs across every network
-    stage of the pipeline (a layer-split pipeline sums its ranges back
-    to the full net). Every network-shape override a step can carry
-    (layer_sizes, num_classes, factored_shortcut, consecutive_frames)
-    is forwarded, so the published evidence matches the network that
-    actually ran, not the R18 default."""
-    from rnb_tpu.models.r2p1d.flops import range_flops_per_clip
-    total = 0
-    for step, views in _config_stage_views(config):
-        model = step.get("model", "")
-        if not model.endswith((".R2P1DSingleStep", ".R2P1DMeshRunner",
-                               ".R2P1DRunner")):
-            continue
-        # one clip flows through ONE replica of the step, so count the
-        # step once — from the first group's merged view (replica groups
-        # share the network shape in every topology this carries)
-        view = views[0]
-        kwargs = dict(
-            consecutive_frames=view.get("consecutive_frames", 8),
-            num_classes=view.get("num_classes", 400),
-            factored_shortcut=view.get("factored_shortcut", False))
-        if view.get("layer_sizes") is not None:
-            kwargs["layer_sizes"] = tuple(view["layer_sizes"])
-        if model.endswith(".R2P1DRunner"):
-            start = view.get("start_index", 1)
-            end = view.get("end_index", 5)
-        else:
-            start, end = 1, 5
-        total += range_flops_per_clip(start, end, **kwargs)
-    return float(total)
+    stage — delegated to rnb_tpu.devobs.flops_per_clip_for_config, the
+    SAME config walk the device observability plane cross-foots its
+    runtime ``compute_profile()`` seam against (``make devobs``), so
+    the evidence line's gflops_per_clip and the Compute: log-meta line
+    share one definition."""
+    from rnb_tpu.devobs import flops_per_clip_for_config
+    return flops_per_clip_for_config(config)
 
 
 def _latency_semantics(config: dict) -> str:
@@ -362,18 +332,12 @@ def _latency_semantics(config: dict) -> str:
 
 
 def _devices_used(config: dict) -> int:
-    """Distinct accelerator devices the topology touches (host -1
-    excluded; a mesh stage counts its whole sub-mesh, including a
-    group-level mesh_devices override)."""
-    used = set()
-    for _step, views in _config_stage_views(config):
-        for view in views:
-            for dev in view.get("mesh_devices", []):
-                used.add(int(dev))
-            for dev in view.get("devices", []):
-                if int(dev) >= 0:
-                    used.add(int(dev))
-    return max(1, len(used))
+    """Distinct accelerator devices the topology touches — delegated
+    to rnb_tpu.devobs.devices_used, the same MFU denominator rule the
+    Compute: log-meta line applies, so the two cross-foot by
+    construction."""
+    from rnb_tpu.devobs import devices_used
+    return devices_used(config)
 
 
 def main() -> int:
@@ -515,6 +479,13 @@ def measure(config: str, num_videos: int, mean_interval: int,
     line["peak_tflops_per_device"] = peak
     line["mfu"] = (round(tflops / (peak * line["devices_used"]), 4)
                    if peak else None)
+    if result.compute_stages:
+        # devobs-enabled runs surface the runtime compute plane's own
+        # figures next to the analytic ones — the `make devobs` gate
+        # holds them equal to the digit (tflops_milli vs
+        # round(tflops, 3); mfu_e4 vs round(mfu, 4); -1 = no peak)
+        line["compute_tflops_milli"] = result.compute_tflops_milli
+        line["compute_mfu_e4"] = result.compute_mfu_e4
     if measured_platform == "tpu":
         line["vs_baseline"] = round(
             result.throughput_vps / BASELINE_VIDEOS_PER_SEC, 3)

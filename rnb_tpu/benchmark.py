@@ -246,6 +246,46 @@ class BenchmarkResult:
     slo_within: int = 0
     slo_missed: int = 0
     slo_burn_max_milli: int = 0
+    #: device compute plane accounting (rnb_tpu.devobs, root `devobs`
+    #: config key): flops-bearing stages metered, dispatches/valid
+    #: rows observed, total achieved FLOPs (per-row counts x rows),
+    #: the measured window in microseconds, the job-level achieved
+    #: TFLOP/s and MFU in bench.py's exact rounding (milli-tflops /
+    #: 1e-4 mfu units; mfu_e4 == -1 when the platform has no known
+    #: peak), and bounded capture windows taken — all zero without
+    #: the key. --check cross-foots flops_total against the per-stage
+    #: detail and the demo gate holds tflops/mfu to bench.py's
+    #: evidence line to the digit.
+    compute_stages: int = 0
+    compute_dispatches: int = 0
+    compute_rows: int = 0
+    compute_flops_total: int = 0
+    compute_window_us: int = 0
+    compute_tflops_milli: int = 0
+    compute_mfu_e4: int = 0
+    compute_captures: int = 0
+    #: per-stage roofline detail (the `Compute stages:` JSON meta
+    #: line): rows, dispatches, flops_per_row, busy_us, tflops_busy,
+    #: mfu_busy, ai_flops_per_byte
+    compute_stage_detail: Dict[str, Any] = field(default_factory=dict)
+    #: HBM footprint ledger accounting (rnb_tpu.memledger, same
+    #: gating): declared owners and devices seen, final/peak resident
+    #: bytes, the watermark threshold and below->above crossings, the
+    #: backend's live-buffer byte total, and whether the ledger's
+    #: live-backed claims reconciled against it (1 = checked and
+    #: consistent; 0 = backend exposes no live list OR the check
+    #: failed — --check flags the latter)
+    memory_owners: int = 0
+    memory_devices: int = 0
+    memory_total_bytes: int = 0
+    memory_peak_bytes: int = 0
+    memory_watermark_bytes: int = 0
+    memory_watermark_hits: int = 0
+    memory_live_bytes: int = 0
+    memory_reconciled: int = 0
+    #: per-owner footprint detail (the `Memory owners:` JSON meta
+    #: line): {owner: {bytes, peak_bytes}}
+    memory_owner_detail: Dict[str, Any] = field(default_factory=dict)
 
 
 def run_benchmark(config_path: str,
@@ -266,6 +306,8 @@ def run_benchmark(config_path: str,
     # (SURVEY.md §2.4 TPU mapping; no-op for single-host runs)
     from rnb_tpu.parallel.distributed import maybe_initialize
     maybe_initialize()
+    from rnb_tpu import devobs as devobs_mod
+    from rnb_tpu import memledger as memledger_mod
     from rnb_tpu import metrics as metrics_mod
     from rnb_tpu import trace as trace_mod
     from rnb_tpu.client import bulk_client, poisson_client
@@ -282,6 +324,8 @@ def run_benchmark(config_path: str,
     # byte-stable); same for the live-metrics registry
     trace_mod.ACTIVE = None
     metrics_mod.ACTIVE = None
+    devobs_mod.ACTIVE = None
+    memledger_mod.ACTIVE = None
 
     config = load_config(config_path)
     config.check_devices()
@@ -539,6 +583,29 @@ def run_benchmark(config_path: str,
         trace_mod.ACTIVE = bridge
         metrics_mod.ACTIVE = metrics_registry
 
+    # device observability plane (rnb_tpu.devobs, root 'devobs' config
+    # key): bounded jax.profiler capture windows (config window /
+    # RNB_DEVOBS_FORCE env / flight-recorder triggers via the metrics
+    # registry's trigger hooks) merged into the trace export as device
+    # tracks, per-stage compute meters behind the Compute: line and
+    # compute.* series, and the HBM footprint ledger
+    # (rnb_tpu.memledger) behind the Memory: line and memory.* gauges.
+    # Stages register their meters/byte sources in the runner
+    # (devobs.register_stage) before the start barrier.
+    devobs_plane = None
+    devobs_settings = devobs_mod.DevObsSettings.from_config(
+        config.devobs)
+    if devobs_settings is not None:
+        devobs_plane = devobs_mod.DevObsPlane(
+            devobs_settings, job_dir=logroot(job_id, base=log_base),
+            job_id=job_id)
+        devobs_mod.ACTIVE = devobs_plane
+        memledger_mod.ACTIVE = devobs_plane.ledger
+        if metrics_registry is not None:
+            metrics_registry.add_poll(devobs_plane.metrics_poll)
+            metrics_registry.trigger_hooks.append(
+                devobs_plane.on_trigger)
+
     threads = []
     client_kwargs = dict(overload_policy=config.overload_policy,
                          fault_stats=fault_stats, counter=counter,
@@ -711,8 +778,15 @@ def run_benchmark(config_path: str,
         # is registered by now (runner registration happens before
         # the start barrier)
         metrics_registry.start()
+    if devobs_plane is not None:
+        # worker up before the barrier (sources are all registered),
+        # but capture windows stay armed until note_run_started below
+        # so warmup compile never lands in a capture
+        devobs_plane.start()
     sta_bar.wait()
     ru_start = resource.getrusage(resource.RUSAGE_SELF)
+    if devobs_plane is not None:
+        devobs_plane.note_run_started()
     time_start = time.time()
     if print_progress:
         print("START! %f" % time_start)
@@ -776,6 +850,20 @@ def run_benchmark(config_path: str,
         # itself keeps running until the final footing flush after
         # every ledger snapshot settled
         trace_mod.ACTIVE = None
+
+    if devobs_plane is not None:
+        # stop the capture worker (any still-armed capture is drained
+        # with a zero-length window first) and clear the module hooks,
+        # then merge the captured device-op intervals into the tracer
+        # as device:<plane> tracks — rid-correlated to the model_call
+        # spans so the exporter's flow chains draw the host->device
+        # arrows — BEFORE the export below writes trace.json
+        devobs_mod.ACTIVE = None
+        memledger_mod.ACTIVE = None
+        devobs_plane.stop()
+        if tracer is not None:
+            tracer.extend(devobs_plane.device_events(
+                devobs_mod.model_call_spans(tracer.snapshot_events())))
 
     # trace export: every thread is drained, so the event set is
     # final; clear the module hook BEFORE exporting so a later run in
@@ -883,6 +971,18 @@ def run_benchmark(config_path: str,
         metrics_registry.stop()
         metrics_mod.ACTIVE = None
         metrics_summary = metrics_registry.summary()
+
+    compute_summary = None
+    memory_summary = None
+    if devobs_plane is not None:
+        # job-level tflops/mfu use bench.py's exact arithmetic over
+        # the SAME measured window, so the Compute: line cross-foots
+        # the bench evidence line to the digit on a clean run; the
+        # memory snapshot re-samples after every thread joined, so
+        # owner rows reflect the settled end-of-run state
+        compute_summary = devobs_plane.compute_summary(
+            total_time, devobs_mod.devices_used(config.raw))
+        memory_summary = devobs_plane.memory_summary()
 
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
@@ -1071,6 +1171,47 @@ def run_benchmark(config_path: str,
                        metrics_summary["slo_within"],
                        metrics_summary["slo_missed"],
                        metrics_summary["burn_max_milli"]))
+        if compute_summary is not None:
+            # every devobs run carries the line (zero-flops when no
+            # stage declares a compute profile — the captures counter
+            # must stay checkable), devobs-off logs stay byte-stable;
+            # --check cross-foots flops_total against the per-stage
+            # detail, recomputes tflops_milli from the integer
+            # fields, and bounds the mfu
+            f.write("Compute: stages=%d dispatches=%d rows=%d "
+                    "flops_total=%d window_us=%d tflops_milli=%d "
+                    "mfu_e4=%d captures=%d\n"
+                    % (compute_summary["stages"],
+                       compute_summary["dispatches"],
+                       compute_summary["rows"],
+                       compute_summary["flops_total"],
+                       compute_summary["window_us"],
+                       compute_summary["tflops_milli"],
+                       compute_summary["mfu_e4"],
+                       compute_summary["captures"]))
+            f.write("Compute stages: %s\n"
+                    % json.dumps(compute_summary["stage_detail"],
+                                 sort_keys=True))
+        if memory_summary is not None:
+            # owner rows MUST sum to total_bytes and peak >= final —
+            # the --check footing invariants; reconciled=1 means the
+            # ledger's live-backed claims fit inside the backend's
+            # own live-buffer total
+            f.write("Memory: owners=%d devices=%d total_bytes=%d "
+                    "peak_bytes=%d watermark_bytes=%d "
+                    "watermark_hits=%d live_bytes=%d reconciled=%d\n"
+                    % (len(memory_summary["owners"]),
+                       len(memory_summary["devices"]),
+                       memory_summary["total_bytes"],
+                       memory_summary["peak_bytes"],
+                       memory_summary["watermark_bytes"],
+                       memory_summary["watermark_hits"],
+                       memory_summary["live_bytes"],
+                       memory_summary["reconciled"]))
+            if memory_summary["owners"]:
+                f.write("Memory owners: %s\n"
+                        % json.dumps(memory_summary["owners"],
+                                     sort_keys=True))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -1163,6 +1304,29 @@ def run_benchmark(config_path: str,
                  metrics_summary["slo_within"],
                  metrics_summary["slo_tracked"],
                  metrics_summary["burn_max_milli"] / 1000.0))
+    if compute_summary is not None and print_progress:
+        print("Compute: %d stage(s), %d dispatch(es), %d row(s), "
+              "%.3f achieved TFLOP/s over the window (mfu %s), "
+              "%d capture(s)"
+              % (compute_summary["stages"],
+                 compute_summary["dispatches"],
+                 compute_summary["rows"],
+                 compute_summary["tflops_milli"] / 1000.0,
+                 ("%.4f" % (compute_summary["mfu_e4"] / 10000.0)
+                  if compute_summary["mfu_e4"] >= 0
+                  else "n/a: unknown device peak"),
+                 compute_summary["captures"]))
+    if memory_summary is not None and print_progress:
+        print("Memory: %.2f MiB resident (peak %.2f MiB) across %d "
+              "owner(s); live-buffer reconcile: %s"
+              % (memory_summary["total_bytes"] / (1 << 20),
+                 memory_summary["peak_bytes"] / (1 << 20),
+                 len(memory_summary["owners"]),
+                 "ok" if memory_summary["reconciled"]
+                 else ("%.2f MiB live"
+                       % (memory_summary["live_bytes"] / (1 << 20))
+                       if memory_summary["live_bytes"]
+                       else "unavailable")))
     if hedge_stats is not None and print_progress:
         print("Hedge: %d fired, %d won by the hedge / %d by the "
               "original, %d ms of loser service wasted"
@@ -1330,6 +1494,41 @@ def run_benchmark(config_path: str,
                     if metrics_summary else 0),
         slo_burn_max_milli=(metrics_summary["burn_max_milli"]
                             if metrics_summary else 0),
+        compute_stages=(compute_summary["stages"]
+                        if compute_summary else 0),
+        compute_dispatches=(compute_summary["dispatches"]
+                            if compute_summary else 0),
+        compute_rows=compute_summary["rows"] if compute_summary else 0,
+        compute_flops_total=(compute_summary["flops_total"]
+                             if compute_summary else 0),
+        compute_window_us=(compute_summary["window_us"]
+                           if compute_summary else 0),
+        compute_tflops_milli=(compute_summary["tflops_milli"]
+                              if compute_summary else 0),
+        compute_mfu_e4=(compute_summary["mfu_e4"]
+                        if compute_summary else 0),
+        compute_captures=(compute_summary["captures"]
+                          if compute_summary else 0),
+        compute_stage_detail=(dict(compute_summary["stage_detail"])
+                              if compute_summary else {}),
+        memory_owners=(len(memory_summary["owners"])
+                       if memory_summary else 0),
+        memory_devices=(len(memory_summary["devices"])
+                        if memory_summary else 0),
+        memory_total_bytes=(memory_summary["total_bytes"]
+                            if memory_summary else 0),
+        memory_peak_bytes=(memory_summary["peak_bytes"]
+                           if memory_summary else 0),
+        memory_watermark_bytes=(memory_summary["watermark_bytes"]
+                                if memory_summary else 0),
+        memory_watermark_hits=(memory_summary["watermark_hits"]
+                               if memory_summary else 0),
+        memory_live_bytes=(memory_summary["live_bytes"]
+                           if memory_summary else 0),
+        memory_reconciled=(memory_summary["reconciled"]
+                           if memory_summary else 0),
+        memory_owner_detail=(dict(memory_summary["owners"])
+                             if memory_summary else {}),
     )
 
 
@@ -1426,6 +1625,9 @@ def main(argv=None) -> int:
         print("metrics: %s"
               % (json.dumps(cfg.metrics, sort_keys=True)
                  if cfg.metrics else "none"))
+        print("devobs: %s"
+              % (json.dumps(cfg.devobs, sort_keys=True)
+                 if cfg.devobs else "none"))
         hedged = {"step%d" % i: s.hedge_ms
                   for i, s in enumerate(cfg.steps)
                   if s.hedge_ms is not None}

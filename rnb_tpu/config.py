@@ -38,7 +38,7 @@ ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
     "trace", "ragged", "handoff", "placement", "health", "deadline",
-    "metrics", "_comment",
+    "metrics", "devobs", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -74,6 +74,11 @@ METRICS_KEYWORDS = ["enabled", "interval_ms", "flight_recorder"]
 FLIGHT_RECORDER_KEYWORDS = ["enabled", "ring_events", "max_dumps",
                             "burn_threshold", "shed_spike_per_s",
                             "queue_saturation", "cooldown_s"]
+
+#: keys a root 'devobs' object may carry (rnb_tpu.devobs)
+DEVOBS_KEYWORDS = ["enabled", "capture_window_ms", "capture_on_trigger",
+                   "max_captures", "capture_max_ops", "watermark_mb",
+                   "sample_hz"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -235,6 +240,17 @@ class PipelineConfig:
     #: dir) and log-meta gains the Metrics:/Slo: lines. Absent => no
     #: registry, byte-stable logs.
     metrics: Optional[Dict[str, Any]] = None
+    #: validated device-observability spec ({"enabled": ..,
+    #: "capture_window_ms": .., "capture_on_trigger": ..,
+    #: "max_captures": .., "capture_max_ops": .., "watermark_mb": ..,
+    #: "sample_hz": ..}), or None; when enabled the launcher builds an
+    #: rnb_tpu.devobs.DevObsPlane (bounded jax.profiler capture
+    #: windows merged into trace.json as device tracks, per-stage
+    #: compute meters feeding the Compute: line and compute.* series,
+    #: and the rnb_tpu.memledger HBM footprint ledger behind the
+    #: Memory: line and memory.* gauges). Absent => no plane,
+    #: byte-stable logs.
+    devobs: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -683,6 +699,40 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     "'metrics.flight_recorder.queue_saturation' must "
                     "be a fraction in (0, 1], got %r" % (sat,))
 
+    devobs = raw.get("devobs")
+    if devobs is not None:
+        _expect(isinstance(devobs, dict), "'devobs' must be an object")
+        unknown_do = sorted(set(devobs) - set(DEVOBS_KEYWORDS))
+        _expect(not unknown_do,
+                "'devobs' has unknown key(s) %s — keys are %s"
+                % (unknown_do, DEVOBS_KEYWORDS))
+        _expect(isinstance(devobs.get("enabled", True), bool),
+                "'devobs.enabled' must be a boolean")
+        _expect(isinstance(devobs.get("capture_on_trigger", True),
+                           bool),
+                "'devobs.capture_on_trigger' must be a boolean")
+        window = devobs.get("capture_window_ms")
+        _expect(window is None
+                or (isinstance(window, (int, float))
+                    and not isinstance(window, bool) and window >= 0),
+                "'devobs.capture_window_ms' must be a non-negative "
+                "number (0 disables the configured window; forced/"
+                "trigger captures still run), got %r" % (window,))
+        for key in ("max_captures", "capture_max_ops"):
+            val = devobs.get(key)
+            _expect(val is None
+                    or (isinstance(val, int)
+                        and not isinstance(val, bool) and val >= 1),
+                    "'devobs.%s' must be a positive integer, got %r"
+                    % (key, val))
+        for key in ("watermark_mb", "sample_hz"):
+            val = devobs.get(key)
+            _expect(val is None
+                    or (isinstance(val, (int, float))
+                        and not isinstance(val, bool) and val > 0),
+                    "'devobs.%s' must be a positive number, got %r"
+                    % (key, val))
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -893,4 +943,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           health=health,
                           deadline=deadline,
                           metrics=metrics,
+                          devobs=devobs,
                           trace=trace)

@@ -125,6 +125,11 @@ class EdgeHandoff:
         self.host_edges = 0
         self.d2d_bytes = 0
         self.host_bytes = 0
+        #: payload bytes resident from the most recent take — what
+        #: this edge's adoptions currently pin on the consumer side
+        #: (the HBM-ledger "handoff" owner, rnb_tpu.memledger; a
+        #: single-threaded int the ledger probe reads without a lock)
+        self.resident_bytes = 0
 
     # -- the take -----------------------------------------------------
 
@@ -165,6 +170,8 @@ class EdgeHandoff:
             out.append(self._rewrap(pb, rehomed))
         self.d2d_edges += 1
         self.d2d_bytes += moved
+        self.resident_bytes = sum(
+            int(getattr(pb.data, "nbytes", 0)) for pb in out)
         return tuple(out)
 
     def _is_resident(self, data) -> bool:
@@ -194,6 +201,8 @@ class EdgeHandoff:
                 pb, jax.device_put(host, self._device)))
         self.host_edges += 1
         self.host_bytes += moved
+        self.resident_bytes = sum(
+            int(getattr(pb.data, "nbytes", 0)) for pb in out)
         return tuple(out)
 
     # -- reporting ----------------------------------------------------

@@ -53,7 +53,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from rnb_tpu import hostprof, metrics, trace
+from rnb_tpu import devobs, hostprof, metrics, trace
 from rnb_tpu.control import (NUM_EXIT_MARKERS, BufferRing, EdgeTracker,
                              FaultStats, InferenceCounter, Signal,
                              TerminationFlag, TerminationState,
@@ -712,6 +712,13 @@ def runner(ctx: RunnerContext) -> None:
         # so every flusher tick sees the full source set (no-op when
         # metrics are off)
         metrics.register_stage(model, handoff)
+        # device observability plane (rnb_tpu.devobs): the stage's
+        # declared compute profile becomes a per-step MFU meter and
+        # its byte-owning subsystems (params, cache, staging, ragged
+        # pool, handoff adoptions) become HBM-ledger sources — all
+        # pre-barrier, so every sample covers the full source set
+        # (no-op when devobs is off)
+        devobs.register_stage(model, ctx.step_idx, ctx.device, handoff)
     except Exception:
         traceback.print_exc()
         ctx.termination.raise_flag(TerminationFlag.INTERNAL_ERROR)
@@ -783,6 +790,10 @@ def runner(ctx: RunnerContext) -> None:
     tr_device_sync = trace.name("exec%d.device_sync", ctx.step_idx)
     tr_publish = trace.name("exec%d.publish", ctx.step_idx)
     tr_handoff = trace.name("exec%d.handoff", ctx.step_idx)
+    # devobs compute meter (rnb_tpu.devobs): resolved once — None when
+    # devobs is off or this stage declares no compute profile, so the
+    # per-dispatch cost of the disabled path is one None test
+    devobs_meter = devobs.meter_for(ctx.step_idx)
 
     # Prefetch (NVVL parity, reference README.md:46-110): a signal-free
     # first stage exposing submit()/complete() gets its next requests'
@@ -1223,6 +1234,38 @@ def runner(ctx: RunnerContext) -> None:
                     # result (service time lands in hedges_wasted_ms,
                     # nothing publishes, nothing double-counts)
                     continue
+                if devobs_meter is not None and flushed is None:
+                    # per-dispatch achieved-FLOPs feed — AFTER the
+                    # hedge-lost discard above, so a loser copy's rows
+                    # never inflate the meter (the same reason the
+                    # autotune service feed sits past that check):
+                    # valid rows are the constituents' num_clips
+                    # stamps with coalesced followers counted 0 — the
+                    # device-work rule clip_counts applies
+                    # (telemetry.TimeCardSummary) — so the Compute:
+                    # line cross-foots bench.py's clips_completed-
+                    # based MFU exactly. The busy span is
+                    # inference_start -> inference_finish (model call
+                    # + device sync), the service-time semantics the
+                    # autotune estimator uses.
+                    cards_dv = _cards_of(time_card)
+                    t_fin_dv = cards_dv[0].timings.get(key_inf_finish)
+                    if t_fin_dv is not None:
+                        # LAST constituent's start, like the autotune
+                        # estimator: an accumulating stage's earlier
+                        # members carry stale starts whose gap is
+                        # batch-fill wait, not device busy time
+                        t_sta_dv = max(
+                            tc_dv.timings.get(key_inf_start, t_fin_dv)
+                            for tc_dv in cards_dv)
+                        rows_dv = 0
+                        for tc_dv in cards_dv:
+                            if not getattr(tc_dv, "cache_coalesced",
+                                           False):
+                                rows_dv += int(getattr(tc_dv,
+                                                       "num_clips", 0))
+                        devobs_meter.note(rows_dv,
+                                          t_fin_dv - t_sta_dv)
                 if controller is not None and tensors_out \
                         and flushed is None \
                         and not getattr(model, "AUTOTUNE_SELF_SERVICE",

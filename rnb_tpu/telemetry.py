@@ -238,6 +238,33 @@ META_LINE_REGISTRY = (
               "JSON per-phase latency attribution "
               "{phase: {mean_ms, p99_ms, count}} over steady-state "
               "completions (trace-enabled runs only)"),
+    StampSpec("Compute:", "rnb_tpu/benchmark.py",
+              "device-compute plane counters (rnb_tpu.devobs): "
+              "flops-bearing stages, dispatches, valid rows, total "
+              "achieved FLOPs, measured window, job tflops/mfu in "
+              "bench.py's exact rounding (tflops_milli / mfu_e4; "
+              "mfu_e4=-1 when the device peak is unknown), capture "
+              "windows taken (devobs-enabled runs only; --check "
+              "cross-foots flops against per-row counts x rows, "
+              "recomputes tflops_milli, and bounds the mfu)"),
+    StampSpec("Compute stages:", "rnb_tpu/benchmark.py",
+              "JSON per-stage roofline detail: rows, dispatches, "
+              "flops_per_row, busy_us, achieved tflops_busy, "
+              "mfu_busy vs the device peak, arithmetic intensity "
+              "from XLA cost_analysis bytes "
+              "(devobs-enabled runs only)"),
+    StampSpec("Memory:", "rnb_tpu/benchmark.py",
+              "HBM footprint ledger totals (rnb_tpu.memledger): "
+              "declared owners, devices, resident/peak bytes, "
+              "watermark threshold and crossings, backend "
+              "live-buffer bytes and the reconciliation verdict "
+              "(devobs-enabled runs only; --check asserts owner "
+              "rows sum to the total and peak >= final)"),
+    StampSpec("Memory owners:", "rnb_tpu/benchmark.py",
+              "JSON per-owner footprint detail {owner: {bytes, "
+              "peak_bytes}} — owners are declared in "
+              "memledger.MEM_OWNER_REGISTRY "
+              "(devobs-enabled runs only)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry
@@ -460,6 +487,32 @@ METRIC_REGISTRY = (
                "bytes adopted/resharded on-device"),
     MetricSpec("handoff.host_bytes", "counter", "poll",
                "bytes moved through host memory"),
+    # -- device observability plane (polled from rnb_tpu.devobs) ------
+    MetricSpec("compute.s{step}.rows", "counter", "poll",
+               "valid rows a flops-bearing stage dispatched"),
+    MetricSpec("compute.s{step}.dispatches", "counter", "poll",
+               "model-call dispatches the compute meter observed"),
+    MetricSpec("compute.s{step}.tflops", "gauge", "poll",
+               "achieved TFLOP/s over the stage's busy time "
+               "(declared per-row FLOPs x rows / busy seconds)"),
+    MetricSpec("compute.s{step}.mfu", "gauge", "poll",
+               "busy-time MFU vs the device peak (absent when the "
+               "platform has no known peak — never guessed)"),
+    MetricSpec("memory.total_bytes", "gauge", "poll",
+               "HBM footprint ledger total across declared owners"),
+    MetricSpec("memory.peak_bytes", "gauge", "poll",
+               "ledger high-water mark (monotone)"),
+    MetricSpec("memory.params_bytes", "gauge", "poll",
+               "device-resident network parameter bytes (deduped "
+               "across replicas sharing one copy)"),
+    MetricSpec("memory.cache_bytes", "gauge", "poll",
+               "clip-cache resident bytes as a ledger owner"),
+    MetricSpec("memory.staging_bytes", "gauge", "poll",
+               "staging-slot slab bytes as a ledger owner"),
+    MetricSpec("memory.ragged_pool_bytes", "gauge", "poll",
+               "ragged pool dispatch-shape bytes as a ledger owner"),
+    MetricSpec("memory.handoff_bytes", "gauge", "poll",
+               "bytes resident from the latest edge adoptions"),
     # -- the live SLO layer (derived inside the registry) -------------
     MetricSpec("slo.good", "rate", "derived",
                "windowed within-deadline completions"),

@@ -40,6 +40,7 @@ epoch header (fallback) scopes the report to the measured window.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from collections import defaultdict
 
@@ -258,18 +259,82 @@ def summarize(intervals, top: int = 15, span_bounds=None):
     }
 
 
+#: log-meta lines of the devobs ledger this script surfaces when
+#: pointed at a job directory (rnb_tpu.devobs / rnb_tpu.memledger)
+LEDGER_PREFIXES = ("Compute:", "Compute stages:", "Memory:",
+                   "Memory owners:")
+
+
+def ledger_lines(job_dir: str):
+    """The job's Compute:/Memory: ledger lines (devobs-enabled runs),
+    read straight from log-meta.txt — the device-accounting context
+    every busy-fraction report below should be read against."""
+    path = os.path.join(job_dir, "log-meta.txt")
+    out = []
+    if os.path.isfile(path):
+        with open(path) as f:
+            for line in f:
+                if line.startswith(LEDGER_PREFIXES):
+                    out.append(line.rstrip("\n"))
+    return out
+
+
+def job_trace_files(job_dir: str):
+    """Every device-op interval artifact a job dir may hold: the
+    ``--xprof`` capture plus the devobs plane's bounded capture
+    windows (same 4-column format)."""
+    names = sorted(os.listdir(job_dir))
+    out = [os.path.join(job_dir, n) for n in names
+           if n == "xprof-ops.txt"
+           or (n.startswith("devobs-capture-") and n.endswith(".txt"))]
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("trace", help="path to xprof-ops.txt")
+    parser.add_argument("trace",
+                        help="path to xprof-ops.txt / a devobs "
+                             "capture, or a logs/<job> directory "
+                             "(reads the devobs ledger lines plus "
+                             "every capture artifact)")
     parser.add_argument("--top", type=int, default=15)
     parser.add_argument("--include-host", action="store_true",
                         help="keep host-side python/thread trace rows")
     args = parser.parse_args(argv)
 
-    everything = load_intervals(args.trace, device_only=False)
+    if os.path.isdir(args.trace):
+        # job-dir mode: the devobs ledger is the accounting of record
+        # — print it first, then analyze every capture artifact
+        lines = ledger_lines(args.trace)
+        for line in lines:
+            print(line)
+        files = job_trace_files(args.trace)
+        if not files:
+            print("no capture artifacts under %s" % args.trace)
+            return 0 if lines else 1
+        status = 0
+        for path in files:
+            print("== %s" % os.path.basename(path))
+            status = max(status, analyze(path, args.top,
+                                         args.include_host))
+        return status
+    return analyze(args.trace, args.top, args.include_host)
+
+
+def analyze(trace_path: str, top: int = 15,
+            include_host: bool = False) -> int:
+    everything = load_intervals(trace_path, device_only=False)
     if not everything:
-        print("no intervals in %s" % args.trace)
+        # a bounded devobs capture can legitimately hold zero ops
+        # (idle window); an empty file with the header is not an error
+        if os.path.basename(trace_path).startswith("devobs-capture-"):
+            print("no intervals in %s (idle capture window)"
+                  % trace_path)
+            return 0
+        print("no intervals in %s" % trace_path)
         return 1
+    args = argparse.Namespace(trace=trace_path, top=top,
+                              include_host=include_host)
     # plane-aware device selection: when the trace names /device:
     # planes, those ARE the device ops — the name heuristic only has
     # to carry legacy 3-column traces (one anonymous "(all)" plane)
@@ -285,6 +350,12 @@ def main(argv=None) -> int:
         if ivals:
             kept[plane] = ivals
     if not kept:
+        if os.path.basename(trace_path).startswith("devobs-capture-"):
+            # a bounded trigger capture can land on an idle/host-only
+            # window — nothing to aggregate is a report, not an error
+            print("no device-op intervals in %s (host-only capture)"
+                  % trace_path)
+            return 0
         print("no device-op intervals in %s" % args.trace)
         return 1
     # one block per plane, busiest first; spans NEVER cross planes

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""``make devobs``: the device observability plane, asserted end-to-end.
+
+Two arms on the 8-virtual-device CPU backend (no dataset — the loader
+decodes synthetic ids deterministically):
+
+* **Main arm** — a reduced-geometry real R(2+1)D pipeline (loader ->
+  network) with ``trace`` + ``metrics`` + ``devobs`` enabled, a
+  configured capture window, a deliberately tiny memory watermark (so
+  the ledger crossing arms the flight recorder AND a trigger capture),
+  and ``RNB_DEVOBS_FORCE`` set — driven through ``bench.measure`` so
+  the end-of-run evidence line exists. Asserts:
+
+  - the merged ``trace.json`` validates (``validate_trace``), carries
+    >= 1 ``device:`` track, and >= 1 flow event binds to that track
+    (host model_call -> device ops arrows render in Perfetto);
+  - the ``Compute:`` line cross-foots bench.py's MFU **to the digit**:
+    ``compute_tflops_milli == round(line["tflops"] * 1000)`` and
+    ``compute_mfu_e4`` matches ``line["mfu"]`` (``-1`` <-> ``null`` on
+    platforms with no known peak — the CPU harness);
+  - the runtime flops seam agrees with the config walk:
+    per-stage ``flops_per_row`` sums to ``gflops_per_clip``;
+  - the ``Memory:`` owner rows foot to the total, the watermark
+    crossing was counted, and the live-buffer reconcile passed;
+  - the forced/window captures produced bounded on-disk artifacts
+    matching the ``captures=`` counter, and ``scripts/device_busy.py``
+    reads the job dir's ledger artifacts (exit 0);
+  - ``parse_utils --check`` is green (the full devobs invariant set).
+
+* **Off arm** — the same tiny pipeline WITHOUT the ``devobs`` key:
+  log-meta must carry no ``Compute:``/``Memory:`` line, no capture
+  artifact may exist, and the timing-table stamp schema must equal the
+  pre-devobs set — the byte-stability contract (PR 6/11 pattern).
+
+Exit 0 = one Perfetto file shows host and device, the live MFU plane
+cross-foots the bench evidence, and the HBM ledger foots.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MAIN_CONFIG = {
+    "_comment": "make-devobs demo: reduced-geometry r2p1d with the "
+                "full observability stack on",
+    "video_path_iterator":
+        "rnb_tpu.models.r2p1d.model.R2P1DVideoPathIterator",
+    "trace": {"enabled": True, "sample_hz": 50},
+    "metrics": {"enabled": True, "interval_ms": 30},
+    # the window must SPAN the dispatch region for the flow-linkage
+    # assertion to be deterministic: captures are serviced serially
+    # from run start, and a window shorter than the decode lead-in
+    # could close before the first network dispatch ever runs (the
+    # teardown truncates an over-long window, so 5 s is an upper
+    # bound, not a floor)
+    "devobs": {"enabled": True, "capture_window_ms": 5000,
+               "watermark_mb": 1, "max_captures": 3,
+               "capture_max_ops": 20000, "sample_hz": 50},
+    "pipeline": [
+        {"model": "rnb_tpu.models.r2p1d.model.R2P1DLoader",
+         "queue_groups": [{"devices": [0], "out_queues": [0]}],
+         "num_shared_tensors": 8, "max_clips": 2,
+         "consecutive_frames": 2,
+         "num_clips_population": [1, 2], "weights": [3, 1],
+         "num_warmups": 1},
+        {"model": "rnb_tpu.models.r2p1d.model.R2P1DRunner",
+         "queue_groups": [{"devices": [1], "in_queue": 0}],
+         "start_index": 1, "end_index": 5, "num_classes": 8,
+         "layer_sizes": [1, 1, 1, 1], "max_rows": 2,
+         "consecutive_frames": 2, "num_warmups": 1},
+    ],
+}
+
+OFF_CONFIG = {
+    "_comment": "make-devobs off arm: tiny pipeline, devobs absent",
+    "video_path_iterator":
+        "tests.pipeline_helpers.CountingPathIterator",
+    "pipeline": [
+        {"model": "tests.pipeline_helpers.TinyRoutedLoader",
+         "queue_groups": [{"devices": [0], "out_queues": [0]}],
+         "num_shared_tensors": 4},
+        {"model": "tests.pipeline_helpers.TinyComputeSink",
+         "queue_groups": [{"devices": [1], "in_queue": 0}]},
+    ],
+}
+
+MAIN_VIDEOS = 6
+
+
+def _captures(log_dir):
+    return sorted(name for name in os.listdir(log_dir)
+                  if name.startswith("devobs-capture-")
+                  and name.endswith(".txt"))
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.trace import validate_trace
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    failures = []
+
+    with tempfile.TemporaryDirectory(prefix="rnb-devobs-") as tmp:
+        # -- main arm --------------------------------------------------
+        cfg_path = os.path.join(tmp, "devobs-demo.json")
+        with open(cfg_path, "w") as f:
+            json.dump(MAIN_CONFIG, f)
+        log_base = os.path.join(tmp, "logs")
+        os.environ.pop("RNB_TPU_DATA_ROOT", None)
+        os.environ["RNB_DEVOBS_FORCE"] = "1"
+        try:
+            line, flag = bench.measure(cfg_path, MAIN_VIDEOS, 0,
+                                       "synthetic", None,
+                                       log_base=log_base)
+        finally:
+            del os.environ["RNB_DEVOBS_FORCE"]
+        if flag != 0:
+            failures.append("main arm terminated with flag %d" % flag)
+        jobs = sorted(os.listdir(log_base))
+        if len(jobs) != 1:
+            print("FAIL: expected one job dir, got %s" % jobs)
+            return 1
+        log_dir = os.path.join(log_base, jobs[0])
+        meta = parse_utils.parse_meta(log_dir)
+
+        # merged host+device timeline
+        trace_path = os.path.join(log_dir, "trace.json")
+        for issue in validate_trace(trace_path):
+            failures.append("trace.json: %s" % issue)
+        doc = json.load(open(trace_path))
+        device_tids = {
+            ev["tid"] for ev in doc["traceEvents"]
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name"
+            and str(ev["args"].get("name", "")).startswith("device:")}
+        flows_on_device = sum(
+            1 for ev in doc["traceEvents"]
+            if ev.get("ph") in ("s", "t", "f")
+            and ev.get("tid") in device_tids)
+        print("main arm: %d device track(s), %d flow event(s) bound "
+              "to them" % (len(device_tids), flows_on_device))
+        if not device_tids:
+            failures.append("merged trace carries no device: track")
+        if not flows_on_device:
+            failures.append("no flow event binds to a device track — "
+                            "the host->device arrows would not render")
+
+        # Compute: cross-foots bench.py's evidence line to the digit
+        want_tflops_milli = int(round(line["tflops"] * 1000))
+        got = meta.get("compute_tflops_milli")
+        print("main arm: bench tflops=%s mfu=%s | Compute: "
+              "tflops_milli=%s mfu_e4=%s rows=%s captures=%s"
+              % (line["tflops"], line["mfu"], got,
+                 meta.get("compute_mfu_e4"), meta.get("compute_rows"),
+                 meta.get("compute_captures")))
+        if got != want_tflops_milli:
+            failures.append(
+                "Compute: tflops_milli=%s does not cross-foot bench's "
+                "tflops=%s (want %d)" % (got, line["tflops"],
+                                         want_tflops_milli))
+        if line["mfu"] is None:
+            if meta.get("compute_mfu_e4") != -1:
+                failures.append(
+                    "bench mfu is null (no known peak) but Compute: "
+                    "mfu_e4=%s != -1" % meta.get("compute_mfu_e4"))
+        elif meta.get("compute_mfu_e4") \
+                != int(round(line["mfu"] * 10000)):
+            failures.append(
+                "Compute: mfu_e4=%s does not cross-foot bench's "
+                "mfu=%s" % (meta.get("compute_mfu_e4"), line["mfu"]))
+        if line.get("compute_tflops_milli") != got:
+            failures.append("bench evidence line's compute_tflops_"
+                            "milli disagrees with the log-meta line")
+
+        # runtime flops seam vs the config walk
+        detail = meta.get("compute_stage_detail", {})
+        seam_gflops = round(sum(int(e["flops_per_row"])
+                                for e in detail.values()) / 1e9, 3)
+        if seam_gflops != line["gflops_per_clip"]:
+            failures.append(
+                "stage-declared flops sum to %s GF/clip but the "
+                "config walk says %s — the runtime seam drifted"
+                % (seam_gflops, line["gflops_per_clip"]))
+
+        # Memory: footing + watermark + reconcile
+        owners = meta.get("memory_owner_detail", {})
+        owner_sum = sum(int(e["bytes"]) for e in owners.values())
+        print("main arm: memory total=%s peak=%s owners=%s "
+              "watermark_hits=%s reconciled=%s"
+              % (meta.get("memory_total_bytes"),
+                 meta.get("memory_peak_bytes"), sorted(owners),
+                 meta.get("memory_watermark_hits"),
+                 meta.get("memory_reconciled")))
+        if owner_sum != meta.get("memory_total_bytes"):
+            failures.append("Memory owners sum to %d but total_bytes="
+                            "%s" % (owner_sum,
+                                    meta.get("memory_total_bytes")))
+        if meta.get("memory_watermark_hits", 0) < 1:
+            failures.append("the 1 MiB watermark never fired against "
+                            "a ~50 MiB parameter footprint")
+        if meta.get("memory_reconciled") != 1:
+            failures.append("live-buffer reconcile did not pass "
+                            "(reconciled=%s, live_bytes=%s)"
+                            % (meta.get("memory_reconciled"),
+                               meta.get("memory_live_bytes")))
+
+        # bounded capture artifacts (forced + window + trigger-armed)
+        captures = _captures(log_dir)
+        if not captures:
+            failures.append("RNB_DEVOBS_FORCE produced no capture "
+                            "artifact")
+        if len(captures) != meta.get("compute_captures"):
+            failures.append("capture artifacts %s != captures=%s"
+                            % (captures, meta.get("compute_captures")))
+
+        # the full --check invariant set, devobs family included
+        for problem in parse_utils.check_job(log_dir):
+            failures.append("--check: %s" % problem)
+
+        # device_busy reads the ledger artifacts from the job dir
+        busy = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "device_busy.py"), log_dir],
+            capture_output=True, text=True)
+        if busy.returncode != 0:
+            failures.append("device_busy.py on the job dir exited %d: "
+                            "%s" % (busy.returncode,
+                                    busy.stderr.strip()[-200:]))
+
+        # -- off arm ---------------------------------------------------
+        off_path = os.path.join(tmp, "devobs-off.json")
+        with open(off_path, "w") as f:
+            json.dump(OFF_CONFIG, f)
+        res = run_benchmark(off_path, mean_interval_ms=1,
+                            num_videos=30, queue_size=50,
+                            log_base=os.path.join(tmp, "off-logs"),
+                            print_progress=False)
+        if res.termination_flag != 0:
+            failures.append("off arm terminated with flag %d"
+                            % res.termination_flag)
+        with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+            meta_text = f.read()
+        if "Compute:" in meta_text or "Memory:" in meta_text:
+            failures.append("devobs-off log-meta carries a Compute:/"
+                            "Memory: line — byte stability broken")
+        if _captures(res.log_dir):
+            failures.append("devobs-off run wrote capture artifacts")
+        tables = [n for n in os.listdir(res.log_dir) if "group" in n]
+        with open(os.path.join(res.log_dir, tables[0])) as f:
+            header = f.readline().split()
+        if header != ["enqueue_filename", "runner0_start",
+                      "inference0_start", "inference0_finish",
+                      "runner1_start", "inference1_start",
+                      "inference1_finish", "device0", "device1"]:
+            failures.append("devobs-off stamp schema drifted: %s"
+                            % header)
+        print("off arm: byte-stable (no devobs lines, no artifacts, "
+              "pre-devobs stamp schema)")
+
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("OK — one Perfetto file shows host AND device, the "
+          "Compute: line cross-foots bench.py to the digit, the "
+          "Memory: ledger foots, and devobs-off stays byte-stable")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,6 +137,39 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["slo_" + key] = int(val)
+        elif line.startswith("Compute stages:"):
+            # JSON per-stage roofline detail (rnb_tpu.devobs) — must
+            # be matched before the "Compute:" prefix below;
+            # devobs-enabled runs only
+            import json
+            meta["compute_stage_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Compute:"):
+            # "Compute: stages=S dispatches=D rows=R flops_total=F
+            #  window_us=W tflops_milli=T mfu_e4=M captures=C" —
+            # device compute plane accounting (rnb_tpu.devobs),
+            # devobs-enabled runs only; --check cross-foots the
+            # per-stage detail, recomputes tflops_milli, and bounds
+            # the mfu (mfu_e4 == -1 means no known device peak)
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["compute_" + key] = int(val)
+        elif line.startswith("Memory owners:"):
+            # JSON per-owner footprint detail {owner: {bytes,
+            # peak_bytes}} — must be matched before the "Memory:"
+            # prefix below; devobs-enabled runs only
+            import json
+            meta["memory_owner_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Memory:"):
+            # "Memory: owners=O devices=D total_bytes=B peak_bytes=P
+            #  watermark_bytes=W watermark_hits=H live_bytes=L
+            #  reconciled=R" — HBM footprint ledger totals
+            # (rnb_tpu.memledger), devobs-enabled runs only; owner
+            # rows must sum to total_bytes and peak >= final
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["memory_" + key] = int(val)
         elif line.startswith("Phases:"):
             # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
             # latency attribution over steady-state completions,
@@ -858,6 +891,12 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # snapshot footing the Faults:/Cache:/Deadline:/Hedge:/Slo:
     # ledgers exactly, and every flight dump structurally valid
     problems.extend(_check_metrics(job_dir, meta))
+    # device observability plane (rnb_tpu.devobs / rnb_tpu.memledger):
+    # per-stage flops must equal per-row counts x rows and sum to the
+    # total, MFU <= 1 wherever a peak is known, memory owner rows must
+    # sum to the ledger total with peak >= final, and every capture
+    # artifact must exist and parse
+    problems.extend(_check_devobs(job_dir, meta))
     return problems, parse_failed
 
 
@@ -1433,6 +1472,263 @@ def _check_metrics(job_dir: str,
     return problems
 
 
+def _devobs_captures(job_dir: str) -> List[str]:
+    return sorted(name for name in os.listdir(job_dir)
+                  if re.fullmatch(r"devobs-capture-\d+\.txt", name))
+
+
+def _check_capture_artifact(path: str) -> List[str]:
+    """Light structural validation of one devobs capture: the
+    xprof-ops 4-column header, an ops_written bound honored by the
+    data rows, and every data row parsing as two integer timestamps
+    (t1 >= t0) plus plane + op name."""
+    base = os.path.basename(path)
+    problems: List[str] = []
+    ops_written = None
+    rows = 0
+    with open(path) as f:
+        first = f.readline()
+        if not first.startswith("# t0_ns t1_ns plane op_name"):
+            return ["%s: missing the '# t0_ns t1_ns plane op_name' "
+                    "header" % base]
+        for line in f:
+            if line.startswith("#"):
+                parts = line.split()
+                if "ops_written" in parts:
+                    ops_written = int(
+                        parts[parts.index("ops_written") + 1])
+                continue
+            rows += 1
+            parts = line.rstrip("\n").split(" ", 3)
+            if len(parts) != 4:
+                problems.append("%s: malformed data row %r"
+                                % (base, line.strip()[:60]))
+                break
+            try:
+                t0, t1 = int(parts[0]), int(parts[1])
+            except ValueError:
+                problems.append("%s: non-integer timestamps in %r"
+                                % (base, line.strip()[:60]))
+                break
+            if t1 < t0:
+                problems.append("%s: interval ends before it starts "
+                                "(%d > %d)" % (base, t0, t1))
+                break
+    if ops_written is None:
+        problems.append("%s: missing the ops_total/ops_written bound "
+                        "header" % base)
+    elif rows != ops_written:
+        problems.append("%s: header says ops_written=%d but the file "
+                        "holds %d row(s)" % (base, ops_written, rows))
+    return problems
+
+
+def _check_devobs(job_dir: str, meta: Dict[str, object]) -> List[str]:
+    """Device-observability invariants (rnb_tpu.devobs /
+    rnb_tpu.memledger): the Compute: line's integer fields must
+    recompute from the per-stage detail (tflops_milli included), MFU
+    stays <= 1 wherever a peak is known, Memory: owner rows sum to
+    the ledger total with peak >= final, and capture artifacts match
+    their counter and parse. Malformed detail values (the adversarial
+    case the tamper tests simulate) surface as findings, never as a
+    checker crash."""
+    try:
+        return _check_devobs_inner(job_dir, meta)
+    except (ValueError, TypeError, KeyError) as e:
+        return ["devobs Compute:/Memory: lines are malformed "
+                "(%s: %s) — the detail JSON does not match the "
+                "declared schema" % (type(e).__name__, e)]
+
+
+def _check_devobs_inner(job_dir: str,
+                        meta: Dict[str, object]) -> List[str]:
+    problems: List[str] = []
+    captures = _devobs_captures(job_dir)
+    if "compute_stages" not in meta and "memory_total_bytes" not in meta:
+        if captures:
+            problems.append("devobs capture artifact(s) %s present "
+                            "but log-meta has no 'Compute:'/'Memory:' "
+                            "line" % captures)
+        return problems
+    if "compute_stages" in meta and "memory_total_bytes" not in meta:
+        problems.append("log-meta carries a 'Compute:' line but no "
+                        "'Memory:' line (the devobs plane writes the "
+                        "ledger totals on every enabled run)")
+    # -- Compute: footing ---------------------------------------------
+    if "compute_stages" in meta:
+        detail = {key: dict(val) for key, val
+                  in dict(meta.get("compute_stage_detail", {})).items()}
+        if len(detail) != meta.get("compute_stages", 0):
+            problems.append(
+                "'Compute stages:' names %d stage(s) but the "
+                "'Compute:' line says stages=%d"
+                % (len(detail), meta.get("compute_stages", 0)))
+        for key in ("compute_dispatches", "compute_rows",
+                    "compute_flops_total", "compute_window_us",
+                    "compute_captures"):
+            if meta.get(key, 0) < 0:
+                problems.append("negative %s" % key)
+        flops_sum = dispatches_sum = 0
+        last_step = None
+        for key, entry in sorted(detail.items()):
+            rows = int(entry.get("rows", 0))
+            per_row = int(entry.get("flops_per_row", 0))
+            flops = int(entry.get("flops", 0))
+            if flops != per_row * rows:
+                problems.append(
+                    "'Compute stages:' %s: flops=%d != flops_per_row"
+                    "=%d x rows=%d (achieved FLOPs are per-row counts "
+                    "times the rows actually dispatched)"
+                    % (key, flops, per_row, rows))
+            if min(rows, per_row, int(entry.get("dispatches", 0)),
+                   int(entry.get("busy_us", 0))) < 0:
+                problems.append("'Compute stages:' %s carries a "
+                                "negative counter" % key)
+            mfu_busy = entry.get("mfu_busy")
+            if mfu_busy is not None and float(mfu_busy) > 1.0001:
+                problems.append(
+                    "'Compute stages:' %s: mfu_busy=%s exceeds 1 — a "
+                    "stage cannot beat the device's peak; the "
+                    "declared FLOPs or the peak table is wrong"
+                    % (key, mfu_busy))
+            flops_sum += flops
+            dispatches_sum += int(entry.get("dispatches", 0))
+            step = int(key[4:])
+            if last_step is None or step > last_step:
+                last_step = step
+                last_rows = rows
+        if flops_sum != meta.get("compute_flops_total", 0):
+            problems.append(
+                "'Compute stages:' flops sum to %d but the 'Compute:' "
+                "line says flops_total=%d" % (
+                    flops_sum, meta.get("compute_flops_total", 0)))
+        if dispatches_sum != meta.get("compute_dispatches", 0):
+            problems.append(
+                "'Compute stages:' dispatches sum to %d but the "
+                "'Compute:' line says dispatches=%d" % (
+                    dispatches_sum, meta.get("compute_dispatches", 0)))
+        if detail and last_rows != meta.get("compute_rows", 0):
+            problems.append(
+                "'Compute:' rows=%d but the last flops-bearing stage "
+                "dispatched %d row(s) (the job row count is the final "
+                "stage's — the completed clips)"
+                % (meta.get("compute_rows", 0), last_rows))
+        if meta.get("compute_mfu_e4", 0) > 10000:
+            problems.append(
+                "compute_mfu_e4=%d exceeds 10000 (MFU > 1: the job "
+                "cannot beat the device peak)"
+                % meta.get("compute_mfu_e4", 0))
+        window_s = meta.get("compute_window_us", 0) / 1e6
+        if detail and window_s > 0:
+            # tflops_milli is fully derivable offline: rows/s x the
+            # summed per-row FLOPs, in the writer's exact expression
+            # order and rounding (±1 milli absorbs the window_us
+            # integer rounding) — a cooked headline number cannot
+            # survive the check
+            flops_per_clip = float(sum(
+                int(entry.get("flops_per_row", 0))
+                for entry in detail.values()))
+            tflops = (meta.get("compute_rows", 0) / window_s) \
+                * flops_per_clip / 1e12
+            want_milli = int(round(round(tflops, 3) * 1000))
+            if abs(int(meta.get("compute_tflops_milli", 0))
+                   - want_milli) > 1:
+                problems.append(
+                    "'Compute:' tflops_milli=%s but rows/window x "
+                    "per-row flops recompute to %d"
+                    % (meta.get("compute_tflops_milli"), want_milli))
+        if "wall_time_s" in meta \
+                and abs(meta.get("compute_window_us", 0) / 1e6
+                        - float(meta["wall_time_s"])) > 0.01:
+            problems.append(
+                "'Compute:' window_us=%d disagrees with the measured "
+                "wall time %.6f s (the compute window IS the measured "
+                "window)" % (meta.get("compute_window_us", 0),
+                             meta["wall_time_s"]))
+        if len(captures) != meta.get("compute_captures", 0):
+            problems.append(
+                "'Compute:' line says captures=%d but the job dir "
+                "holds %d capture artifact(s): %s"
+                % (meta.get("compute_captures", 0), len(captures),
+                   captures))
+    # -- Memory: footing ----------------------------------------------
+    if "memory_total_bytes" in meta:
+        detail = {key: dict(val) for key, val
+                  in dict(meta.get("memory_owner_detail", {})).items()}
+        if len(detail) != meta.get("memory_owners", 0):
+            problems.append(
+                "'Memory owners:' names %d owner(s) but the 'Memory:' "
+                "line says owners=%d"
+                % (len(detail), meta.get("memory_owners", 0)))
+        _rnb_trace()  # side effect: repo checkout on sys.path
+        from rnb_tpu.memledger import MEM_OWNERS
+        rogue = sorted(set(detail) - set(MEM_OWNERS))
+        if rogue:
+            problems.append(
+                "'Memory owners:' names undeclared owner(s) %s — "
+                "owners are declared in memledger.MEM_OWNER_REGISTRY"
+                % rogue)
+        owner_sum = 0
+        for owner, entry in sorted(detail.items()):
+            nbytes = int(entry.get("bytes", 0))
+            peak = int(entry.get("peak_bytes", 0))
+            if nbytes < 0 or peak < 0:
+                problems.append("'Memory owners:' %s carries negative "
+                                "bytes" % owner)
+            if peak < nbytes:
+                problems.append(
+                    "'Memory owners:' %s: peak_bytes=%d below final "
+                    "bytes=%d (the high-water mark covers every "
+                    "sample, the final one included)"
+                    % (owner, peak, nbytes))
+            owner_sum += nbytes
+        if owner_sum != meta.get("memory_total_bytes", 0):
+            problems.append(
+                "'Memory owners:' bytes sum to %d but the 'Memory:' "
+                "line says total_bytes=%d (owner rows must foot to "
+                "the ledger total)"
+                % (owner_sum, meta.get("memory_total_bytes", 0)))
+        if meta.get("memory_peak_bytes", 0) \
+                < meta.get("memory_total_bytes", 0):
+            problems.append(
+                "memory_peak_bytes=%d below memory_total_bytes=%d "
+                "(peak >= final by construction)"
+                % (meta.get("memory_peak_bytes", 0),
+                   meta.get("memory_total_bytes", 0)))
+        if meta.get("memory_watermark_hits", 0) > 0:
+            if meta.get("memory_watermark_bytes", 0) <= 0:
+                problems.append(
+                    "memory_watermark_hits=%d with no configured "
+                    "watermark" % meta["memory_watermark_hits"])
+            elif meta.get("memory_peak_bytes", 0) \
+                    < meta.get("memory_watermark_bytes", 0):
+                problems.append(
+                    "memory_watermark_hits=%d but the peak %d never "
+                    "reached the %d-byte watermark"
+                    % (meta["memory_watermark_hits"],
+                       meta.get("memory_peak_bytes", 0),
+                       meta.get("memory_watermark_bytes", 0)))
+        if meta.get("memory_reconciled", 0) not in (0, 1):
+            problems.append("memory_reconciled must be 0 or 1, got %s"
+                            % meta.get("memory_reconciled"))
+        if meta.get("memory_reconciled", 0) == 1 \
+                and meta.get("memory_live_bytes", 0) <= 0:
+            problems.append(
+                "memory_reconciled=1 with live_bytes=0 (a reconcile "
+                "verdict needs the backend's live-buffer total)")
+        if meta.get("memory_live_bytes", 0) > 0 \
+                and meta.get("memory_reconciled", 0) != 1:
+            problems.append(
+                "live_bytes=%d but reconciled=0 — the ledger's "
+                "live-backed claims exceed the backend's own live "
+                "buffers (the ledger is lying about device memory)"
+                % meta.get("memory_live_bytes", 0))
+    for name_ in captures:
+        problems.extend(
+            _check_capture_artifact(os.path.join(job_dir, name_)))
+    return problems
+
+
 def _configured_buckets(job_dir: str) -> set:
     """Every row count the job's config could legally warm: the union
     of ``row_buckets`` / ``max_clips`` / ``max_rows`` values across
@@ -1488,6 +1784,7 @@ def print_stamp_registry(out=None) -> None:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in _sys.path:
         _sys.path.insert(0, repo)
+    from rnb_tpu.memledger import MEM_OWNER_REGISTRY
     from rnb_tpu.telemetry import (META_LINE_REGISTRY, METRIC_REGISTRY,
                                    STAMP_REGISTRY,
                                    TABLE_TRAILER_REGISTRY,
@@ -1526,6 +1823,12 @@ def print_stamp_registry(out=None) -> None:
         out.write("%-26s %-10s %-7s %s\n"
                   % (spec.pattern, spec.kind, spec.source,
                      spec.description))
+    out.write("\n## HBM-ledger owners (the 'Memory owners:' line's "
+              "keys,\n## devobs-enabled runs only; declared in "
+              "rnb_tpu.memledger)\n")
+    for spec in MEM_OWNER_REGISTRY:
+        out.write("%-26s %-22s %s\n" % (spec.name, spec.producer,
+                                        spec.description))
 
 
 def main(argv=None) -> int:

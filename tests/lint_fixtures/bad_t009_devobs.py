@@ -1,0 +1,13 @@
+"""RNB-T009: emits an unregistered compute.* series next to the
+registered devobs vocabulary (so no dead-registry finding muddies the
+fixture)."""
+
+from rnb_tpu import metrics
+
+
+def emit(step, tflops, nbytes):
+    metrics.gauge(metrics.name("compute.s%d.tflops", step), tflops)
+    metrics.counter(metrics.name("compute.s%d.rows", step))
+    metrics.gauge("memory.total_bytes", nbytes)
+    metrics.gauge("memory.cache_bytes", nbytes)
+    metrics.gauge("compute.s0.mystery", tflops)

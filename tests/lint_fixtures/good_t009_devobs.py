@@ -1,0 +1,13 @@
+"""Schema fixture: emits exactly the (test-local) registered devobs
+metric series — the compute.*/memory.* vocabulary the device
+observability plane streams — through the entry-point shapes the
+extractor must see."""
+
+from rnb_tpu import metrics
+
+
+def emit(step, tflops, nbytes):
+    metrics.gauge(metrics.name("compute.s%d.tflops", step), tflops)
+    metrics.counter(metrics.name("compute.s%d.rows", step))
+    metrics.gauge("memory.total_bytes", nbytes)
+    metrics.gauge("memory.cache_bytes", nbytes)

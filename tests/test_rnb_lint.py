@@ -307,6 +307,33 @@ def test_dead_site_metric_registry_entry():
         == {("RNB-T003", "ghost.series")}
 
 
+def _devobs_metric_registry():
+    from rnb_tpu.telemetry import MetricSpec
+    return (MetricSpec("compute.s{step}.tflops", "gauge", "poll", "f"),
+            MetricSpec("compute.s{step}.rows", "counter", "poll", "f"),
+            MetricSpec("memory.total_bytes", "gauge", "poll", "f"),
+            MetricSpec("memory.cache_bytes", "gauge", "poll", "f"))
+
+
+def test_devobs_metric_fixture_is_clean():
+    # the RNB-T009 family covers the compute.*/memory.* vocabulary:
+    # the good fixture emits exactly the declared devobs series
+    from rnb_tpu.analysis.schema import check_metric_names
+    findings = check_metric_names([_fixture("good_t009_devobs.py")],
+                                  root=FIXTURES,
+                                  registry=_devobs_metric_registry())
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_unregistered_devobs_metric_triggers_t009():
+    from rnb_tpu.analysis.schema import check_metric_names
+    findings = check_metric_names([_fixture("bad_t009_devobs.py")],
+                                  root=FIXTURES,
+                                  registry=_devobs_metric_registry())
+    assert {(f.rule, f.anchor) for f in findings} \
+        == {("RNB-T009", "compute.s0.mystery")}
+
+
 def test_repo_metric_names_all_registered():
     # the real tree: every emitted metric series name is declared and
     # every declared site-sourced name is still emitted somewhere
@@ -370,6 +397,10 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Warmup: %s\\n" % w)\n'
                      'f.write("Metrics: snapshots=%d\\n" % ms)\n'
                      'f.write("Slo: tracked=%d\\n" % sl)\n'
+                     'f.write("Compute: stages=%d\\n" % cp)\n'
+                     'f.write("Compute stages: %s\\n" % cs)\n'
+                     'f.write("Memory: owners=%d\\n" % mb)\n'
+                     'f.write("Memory owners: %s\\n" % mo)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -389,12 +420,11 @@ def test_unparsed_meta_line_triggers_t005(tmp_path):
         == {("RNB-T005", "Ghost:")}
 
 
-def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
-    from rnb_tpu.analysis.schema import check_benchmark_result
-    bench = tmp_path / "bench_like.py"
-    bench.write_text(
+#: every key=value counter family a benchmark-like module writes,
+#: shared by the RNB-T006 tests below (the devobs lines ride on top)
+REPO_BENCH_LIKE = (
         'f.write("Faults: num_failed=%d num_shed=%d num_retries=%d '
-        'num_bogus=%d\\n" % x)\n'
+        '\\n" % x)\n'
         'f.write("Cache: hits=%d misses=%d inserts=%d evictions=%d '
         'coalesced=%d oversize=%d bytes_resident=%d\\n" % y)\n'
         'f.write("Staging: slots=%d slot_bytes=%d acquires=%d '
@@ -419,10 +449,42 @@ def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
         'f.write("Metrics: snapshots=%d series=%d dumps=%d '
         'triggers=%d\\n" % ms)\n'
         'f.write("Slo: tracked=%d within=%d missed=%d '
-        'burn_max_milli=%d\\n" % sl)\n')
+        'burn_max_milli=%d\\n" % sl)\n'
+        'f.write("Compute: stages=%d dispatches=%d rows=%d '
+        'flops_total=%d window_us=%d tflops_milli=%d mfu_e4=%d '
+        'captures=%d\\n" % cp)\n'
+        'f.write("Memory: owners=%d devices=%d total_bytes=%d '
+        'peak_bytes=%d watermark_bytes=%d watermark_hits=%d '
+        'live_bytes=%d reconciled=%d\\n" % mm)\n')
+
+
+def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
+    from rnb_tpu.analysis.schema import check_benchmark_result
+    bench = tmp_path / "bench_like.py"
+    bench.write_text(REPO_BENCH_LIKE.replace(
+        'num_retries=%d \\n', 'num_retries=%d num_bogus=%d\\n'))
     findings = check_benchmark_result(str(bench), root=str(tmp_path))
     assert {(f.rule, f.anchor) for f in findings} \
         == {("RNB-T006", "num_bogus")}
+
+
+def test_compute_memory_counter_drift_triggers_t006(tmp_path):
+    """The RNB-T006 family covers the devobs lines: a Compute:/Memory:
+    counter with no BenchmarkResult twin is drift, and a compute_/
+    memory_ result field nothing writes is invisible offline."""
+    from rnb_tpu.analysis.schema import check_benchmark_result
+    bench = tmp_path / "bench_like.py"
+    # bogus keys added to both devobs lines on top of the complete
+    # legitimate families, so exactly the two bogus fields surface
+    src = (REPO_BENCH_LIKE
+           .replace('captures=%d\\n', 'captures=%d bogus_flops=%d\\n')
+           .replace('reconciled=%d\\n',
+                    'reconciled=%d bogus_bytes=%d\\n'))
+    bench.write_text(src)
+    findings = check_benchmark_result(str(bench), root=str(tmp_path))
+    anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
+    assert "compute_bogus_flops" in anchors
+    assert "memory_bogus_bytes" in anchors
 
 
 def test_schema_checker_clean_on_repo():

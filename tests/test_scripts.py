@@ -485,3 +485,111 @@ def test_decode_bench_smoke(tmp_path):
     empty.mkdir()
     assert _sp.run([sys.executable, script, str(empty)],
                    capture_output=True).returncode != 0
+
+
+def test_bench_diff_rules(tmp_path):
+    """bench_diff's per-cell rules: ok->failed, throughput below the
+    tolerance floor, and a vanished row are regressions; new rows and
+    improvements are not."""
+    import bench_diff
+
+    baseline = {
+        "a.json": {"videos_per_sec": 1.0, "ok": True,
+                   "termination_flag": 0},
+        "b.json": {"videos_per_sec": 1.0, "ok": True,
+                   "termination_flag": 0},
+        "c.json": {"videos_per_sec": 1.0, "ok": True,
+                   "termination_flag": 0},
+        "gone.json": {"videos_per_sec": 1.0, "ok": True,
+                      "termination_flag": 0},
+    }
+    current = {
+        "a.json": {"videos_per_sec": 0.6, "ok": True,
+                   "termination_flag": 0},   # below the 30% floor
+        "b.json": {"videos_per_sec": 2.0, "ok": True,
+                   "termination_flag": 0},   # improvement: fine
+        "c.json": {"videos_per_sec": 1.0, "ok": False,
+                   "termination_flag": 3},   # was ok, now failed
+        "new.json": {"videos_per_sec": 0.1, "ok": True,
+                     "termination_flag": 0},  # new row: fine
+    }
+    lines, regressions = bench_diff.diff(baseline, current, 0.30)
+    assert regressions == 3
+    text = "\n".join(lines)
+    assert "REGRESSION a.json" in text.replace("   ", " ") \
+        or "a.json" in text
+    assert sum(1 for line in lines if "REGRESSION" in line) == 2
+    assert sum(1 for line in lines if "MISSING" in line) == 1
+    assert sum(1 for line in lines if "NEW" in line) == 1
+    # within tolerance: no regression
+    lines, regressions = bench_diff.diff(
+        baseline, dict(current, **{
+            "a.json": {"videos_per_sec": 0.75, "ok": True,
+                       "termination_flag": 0},
+            "c.json": baseline["c.json"],
+            "gone.json": baseline["gone.json"]}), 0.30)
+    assert regressions == 0
+
+
+def test_bench_diff_committed_artifacts_are_green():
+    """The committed matrix must clear the committed floor — the
+    `make benchdiff` contract a fresh checkout starts from."""
+    import bench_diff
+    assert bench_diff.main([]) == 0
+
+
+def test_bench_diff_cli_detects_regression(tmp_path):
+    import json as _json
+
+    import bench_diff
+    base = {"configs": [{"config": "x.json", "videos_per_sec": 1.0,
+                         "ok": True, "termination_flag": 0}]}
+    cur = {"configs": [{"config": "x.json", "videos_per_sec": 0.1,
+                        "ok": True, "termination_flag": 0}]}
+    bpath, cpath = tmp_path / "base.json", tmp_path / "cur.json"
+    bpath.write_text(_json.dumps(base))
+    cpath.write_text(_json.dumps(cur))
+    assert bench_diff.main(["--baseline", str(bpath),
+                            "--current", str(cpath)]) == 1
+    assert bench_diff.main(["--baseline", str(bpath),
+                            "--current", str(cpath),
+                            "--tolerance", "0.95"]) == 0
+    assert bench_diff.main(["--baseline", str(tmp_path / "nope.json"),
+                            "--current", str(cpath)]) == 2
+
+
+def test_device_busy_job_dir_reads_ledger_and_captures(tmp_path,
+                                                       capsys):
+    """Job-dir mode: the devobs ledger lines print first, every
+    capture artifact is analyzed, and an idle capture is a report,
+    not an error."""
+    import device_busy
+
+    job = tmp_path / "job"
+    job.mkdir()
+    (job / "log-meta.txt").write_text(
+        "Args: Namespace()\n"
+        "Compute: stages=1 dispatches=2 rows=3 flops_total=30 "
+        "window_us=1000 tflops_milli=0 mfu_e4=-1 captures=1\n"
+        "Memory: owners=1 devices=1 total_bytes=16 peak_bytes=16 "
+        "watermark_bytes=0 watermark_hits=0 live_bytes=0 "
+        "reconciled=0\n")
+    (job / "devobs-capture-0.txt").write_text(
+        "# t0_ns t1_ns plane op_name\n"
+        "# window_epoch 0.0 1.0 flush_epoch 1.0\n"
+        "# trigger window ops_total 1 ops_written 1\n"
+        "100 200 /device:TPU:0 fusion.1\n")
+    assert device_busy.main([str(job)]) == 0
+    out = capsys.readouterr().out
+    assert "Compute: stages=1" in out
+    assert "Memory: owners=1" in out
+    assert "devobs-capture-0.txt" in out
+    # an idle (empty) capture must not fail the report
+    (job / "devobs-capture-1.txt").write_text(
+        "# t0_ns t1_ns plane op_name\n"
+        "# trigger forced ops_total 0 ops_written 0\n")
+    assert device_busy.main([str(job)]) == 0
+    # a dir with neither ledger nor artifacts is an error
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert device_busy.main([str(empty)]) == 1
