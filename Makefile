@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: lint test native stamps trace ragged multichip chaos metrics dct \
-	devobs benchdiff
+	devobs benchdiff explain
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -89,6 +89,16 @@ devobs:
 # `python scripts/bench_diff.py --update`).
 benchdiff:
 	$(PYTHON) scripts/bench_diff.py
+
+# Explanation-plane gate (README "Explanation plane"): a traced
+# critpath run whose blocking chains partition end-to-end latency
+# (parse_utils --explain + --check green), the what-if engine
+# calibrated from a fresh r1 scale-out arm predicting the committed
+# r4/r1 cells' throughput ratio within 25%, and rnb_diff on the
+# committed logs/pr12-dct-ab pair naming the decode/ingest phase as
+# the top significant work-phase delta.
+explain:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/explain_demo.py
 
 native:
 	$(MAKE) -C native

@@ -286,6 +286,36 @@ class BenchmarkResult:
     #: per-owner footprint detail (the `Memory owners:` JSON meta
     #: line): {owner: {bytes, peak_bytes}}
     memory_owner_detail: Dict[str, Any] = field(default_factory=dict)
+    #: critical-path extraction accounting (rnb_tpu.critpath, root
+    #: `critpath` config key): completed requests whose blocking
+    #: chain was recovered, total chain segments, the worst
+    #: per-request partition residual (microseconds — --check holds
+    #: it under 1000), hedge-won and redispatched completions, and
+    #: the binding stage's critical-path throughput bound — all zero
+    #: without the key.
+    critpath_requests: int = 0
+    critpath_segments: int = 0
+    critpath_residual_us_max: int = 0
+    critpath_hedged: int = 0
+    critpath_redispatched: int = 0
+    critpath_bound_step: int = 0
+    critpath_bound_vps_milli: int = 0
+    #: per-stage blocking attribution (the `Critpath stages:` JSON
+    #: meta line): lanes, per-class blocked totals, occupied ms,
+    #: bound_vps
+    critpath_stage_detail: Dict[str, Any] = field(default_factory=dict)
+    #: calibrated queueing what-if engine accounting (rnb_tpu.whatif,
+    #: root `whatif` config key — requires `metrics`): stages the
+    #: model calibrated from the final metrics snapshot, whether
+    #: calibration succeeded, the model's self-predicted throughput
+    #: (milli-vps) and its predicted bottleneck step (-1 when
+    #: uncalibrated) — all zero/-1 without the key. --check
+    #: recomputes the prediction offline from metrics.jsonl + the
+    #: config copy and holds it to +-1 milli-vps.
+    whatif_stages: int = 0
+    whatif_calibrated: int = 0
+    whatif_pred_vps_milli: int = 0
+    whatif_bottleneck_step: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -606,6 +636,16 @@ def run_benchmark(config_path: str,
             metrics_registry.trigger_hooks.append(
                 devobs_plane.on_trigger)
 
+    # the explanation plane (rnb_tpu.critpath / rnb_tpu.whatif):
+    # blocking-chain extraction over completed requests' stamps, and
+    # the calibrated queueing what-if model built from the metrics
+    # plane at teardown — both fully off (byte-stable logs) without
+    # their root config keys
+    from rnb_tpu.critpath import CritpathSettings
+    from rnb_tpu.whatif import WhatifSettings
+    critpath_settings = CritpathSettings.from_config(config.critpath)
+    whatif_settings = WhatifSettings.from_config(config.whatif)
+
     threads = []
     client_kwargs = dict(overload_policy=config.overload_policy,
                          fault_stats=fault_stats, counter=counter,
@@ -716,6 +756,7 @@ def run_benchmark(config_path: str,
                                if step.replica_queues
                                and group.in_queue
                                in step.replica_queues else None),
+                    critpath=critpath_settings is not None,
                 )
                 threads.append(threading.Thread(
                     target=runner, args=(ctx,),
@@ -894,6 +935,21 @@ def run_benchmark(config_path: str,
                 merged.setdefault(phase, []).extend(vals)
         phases_stats = phase_stats(merged) or None
 
+    # critical-path extraction (rnb_tpu.critpath): the blocking-chain
+    # aggregation over every final instance's steady completions —
+    # stamps only, so it costs nothing on the hot path; hedge/
+    # redispatch content stamps ride along from the summaries
+    critpath_report = None
+    if critpath_settings is not None and summary_sink:
+        from rnb_tpu.critpath import aggregate as critpath_aggregate
+        lanes_by_step = {
+            step_idx: sum(len(g.devices) for g in step.groups)
+            for step_idx, step in enumerate(config.steps)}
+        critpath_report = critpath_aggregate(
+            (row for s in summary_sink
+             for row in s.steady_rows(NUM_SUMMARY_SKIPS)),
+            lanes_by_step)
+
     # decoded-clip cache accounting: cache-owning stages appended
     # their final snapshots before the finish barrier (rnb_tpu.runner)
     cache_stats = None
@@ -971,6 +1027,25 @@ def run_benchmark(config_path: str,
         metrics_registry.stop()
         metrics_mod.ACTIVE = None
         metrics_summary = metrics_registry.summary()
+
+    # what-if engine calibration (rnb_tpu.whatif): built from the
+    # FINAL metrics snapshot — the same dict metrics.jsonl holds as
+    # its last record, so parse_utils --check can recompute the
+    # Whatif: line from the artifacts alone and hold the two equal
+    whatif_counters = None
+    if whatif_settings is not None:
+        from rnb_tpu import whatif as whatif_mod
+        whatif_model = None
+        if metrics_registry is not None:
+            final_snap = metrics_registry.final_snapshot()
+            if final_snap is not None:
+                whatif_model = whatif_mod.calibrate_from_snapshot(
+                    final_snap,
+                    whatif_mod.steps_info_from_config(config.raw),
+                    wall_s=total_time,
+                    arrival_hz=whatif_mod.arrival_hz_from_snapshot(
+                        final_snap))
+        whatif_counters = whatif_mod.summary_counters(whatif_model)
 
     compute_summary = None
     memory_summary = None
@@ -1212,6 +1287,34 @@ def run_benchmark(config_path: str,
                 f.write("Memory owners: %s\n"
                         % json.dumps(memory_summary["owners"],
                                      sort_keys=True))
+        if critpath_report is not None:
+            # only critpath-enabled runs carry the lines, keeping
+            # earlier logs byte-stable; --check re-derives every
+            # field from the timing tables and holds the partition
+            # residual under 1 ms per request
+            f.write("Critpath: requests=%d segments=%d "
+                    "residual_us_max=%d hedged=%d redispatched=%d "
+                    "bound_step=%d bound_vps_milli=%d\n"
+                    % (critpath_report["requests"],
+                       critpath_report["segments"],
+                       critpath_report["residual_us_max"],
+                       critpath_report["hedged"],
+                       critpath_report["redispatched"],
+                       critpath_report["bound_step"],
+                       critpath_report["bound_vps_milli"]))
+            f.write("Critpath stages: %s\n"
+                    % json.dumps(critpath_report["stage_detail"],
+                                 sort_keys=True))
+        if whatif_counters is not None:
+            # only whatif-enabled runs carry the line; --check
+            # recomputes the prediction from metrics.jsonl + the
+            # config copy alone and holds it to +-1 milli-vps
+            f.write("Whatif: stages=%d calibrated=%d "
+                    "pred_vps_milli=%d bottleneck_step=%d\n"
+                    % (whatif_counters["stages"],
+                       whatif_counters["calibrated"],
+                       whatif_counters["pred_vps_milli"],
+                       whatif_counters["bottleneck_step"]))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -1355,6 +1458,23 @@ def run_benchmark(config_path: str,
             s = phases_stats[phase]
             print("  %-18s %8.3f / %8.3f  (n=%d)"
                   % (phase, s["mean_ms"], s["p99_ms"], s["count"]))
+    if critpath_report is not None and print_progress:
+        from rnb_tpu.critpath import ranking as critpath_ranking
+        ranked = critpath_ranking(critpath_report["stage_detail"])
+        print("Critpath: %d request(s), top blockers %s; bound "
+              "step%d at %.3f videos/s"
+              % (critpath_report["requests"],
+                 ", ".join("%s %.1f ms" % (seg, total)
+                           for seg, total, _mean in ranked[:3]),
+                 critpath_report["bound_step"],
+                 critpath_report["bound_vps_milli"] / 1000.0))
+    if whatif_counters is not None and print_progress:
+        print("Whatif: %d stage(s) calibrated=%d, self-predicted "
+              "%.3f videos/s (bottleneck step %d)"
+              % (whatif_counters["stages"],
+                 whatif_counters["calibrated"],
+                 whatif_counters["pred_vps_milli"] / 1000.0,
+                 whatif_counters["bottleneck_step"]))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -1529,6 +1649,30 @@ def run_benchmark(config_path: str,
                            if memory_summary else 0),
         memory_owner_detail=(dict(memory_summary["owners"])
                              if memory_summary else {}),
+        critpath_requests=(critpath_report["requests"]
+                           if critpath_report else 0),
+        critpath_segments=(critpath_report["segments"]
+                           if critpath_report else 0),
+        critpath_residual_us_max=(critpath_report["residual_us_max"]
+                                  if critpath_report else 0),
+        critpath_hedged=(critpath_report["hedged"]
+                         if critpath_report else 0),
+        critpath_redispatched=(critpath_report["redispatched"]
+                               if critpath_report else 0),
+        critpath_bound_step=(critpath_report["bound_step"]
+                             if critpath_report else 0),
+        critpath_bound_vps_milli=(critpath_report["bound_vps_milli"]
+                                  if critpath_report else 0),
+        critpath_stage_detail=(dict(critpath_report["stage_detail"])
+                               if critpath_report else {}),
+        whatif_stages=(whatif_counters["stages"]
+                       if whatif_counters else 0),
+        whatif_calibrated=(whatif_counters["calibrated"]
+                           if whatif_counters else 0),
+        whatif_pred_vps_milli=(whatif_counters["pred_vps_milli"]
+                               if whatif_counters else 0),
+        whatif_bottleneck_step=(whatif_counters["bottleneck_step"]
+                                if whatif_counters else 0),
     )
 
 
@@ -1628,6 +1772,11 @@ def main(argv=None) -> int:
         print("devobs: %s"
               % (json.dumps(cfg.devobs, sort_keys=True)
                  if cfg.devobs else "none"))
+        print("critpath: %s; whatif: %s"
+              % (json.dumps(cfg.critpath, sort_keys=True)
+                 if cfg.critpath else "none",
+                 json.dumps(cfg.whatif, sort_keys=True)
+                 if cfg.whatif else "none"))
         hedged = {"step%d" % i: s.hedge_ms
                   for i, s in enumerate(cfg.steps)
                   if s.hedge_ms is not None}

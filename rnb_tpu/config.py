@@ -38,7 +38,7 @@ ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
     "trace", "ragged", "handoff", "placement", "health", "deadline",
-    "metrics", "devobs", "_comment",
+    "metrics", "devobs", "critpath", "whatif", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -79,6 +79,12 @@ FLIGHT_RECORDER_KEYWORDS = ["enabled", "ring_events", "max_dumps",
 DEVOBS_KEYWORDS = ["enabled", "capture_window_ms", "capture_on_trigger",
                    "max_captures", "capture_max_ops", "watermark_mb",
                    "sample_hz"]
+
+#: keys a root 'critpath' object may carry (rnb_tpu.critpath)
+CRITPATH_KEYWORDS = ["enabled"]
+
+#: keys a root 'whatif' object may carry (rnb_tpu.whatif)
+WHATIF_KEYWORDS = ["enabled"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -251,6 +257,19 @@ class PipelineConfig:
     #: Memory: line and memory.* gauges). Absent => no plane,
     #: byte-stable logs.
     devobs: Optional[Dict[str, Any]] = None
+    #: validated critical-path extraction spec ({"enabled": ..}), or
+    #: None; when enabled the launcher recovers every completed
+    #: request's blocking chain from its TimeCard stamps
+    #: (rnb_tpu.critpath) and log-meta gains the Critpath:/Critpath
+    #: stages: lines plus a `# critpath` table trailer. Absent =>
+    #: byte-stable logs.
+    critpath: Optional[Dict[str, Any]] = None
+    #: validated what-if engine spec ({"enabled": ..}), or None; when
+    #: enabled (requires `metrics` — the service histograms ARE the
+    #: calibration data) the launcher calibrates a per-stage queueing
+    #: model at teardown (rnb_tpu.whatif) and log-meta gains the
+    #: Whatif: line. Absent => byte-stable logs.
+    whatif: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -733,6 +752,33 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     "'devobs.%s' must be a positive number, got %r"
                     % (key, val))
 
+    critpath = raw.get("critpath")
+    if critpath is not None:
+        _expect(isinstance(critpath, dict),
+                "'critpath' must be an object")
+        unknown_cp = sorted(set(critpath) - set(CRITPATH_KEYWORDS))
+        _expect(not unknown_cp,
+                "'critpath' has unknown key(s) %s — keys are %s"
+                % (unknown_cp, CRITPATH_KEYWORDS))
+        _expect(isinstance(critpath.get("enabled", True), bool),
+                "'critpath.enabled' must be a boolean")
+
+    whatif = raw.get("whatif")
+    if whatif is not None:
+        _expect(isinstance(whatif, dict), "'whatif' must be an object")
+        unknown_wi = sorted(set(whatif) - set(WHATIF_KEYWORDS))
+        _expect(not unknown_wi,
+                "'whatif' has unknown key(s) %s — keys are %s"
+                % (unknown_wi, WHATIF_KEYWORDS))
+        _expect(isinstance(whatif.get("enabled", True), bool),
+                "'whatif.enabled' must be a boolean")
+        if whatif.get("enabled", True):
+            _expect(isinstance(metrics, dict)
+                    and metrics.get("enabled", True),
+                    "'whatif' requires an enabled root 'metrics' key "
+                    "— the per-stage service histograms streamed to "
+                    "metrics.jsonl are the calibration data")
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -942,6 +988,8 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           placement=placement,
                           health=health,
                           deadline=deadline,
+                          critpath=critpath,
+                          whatif=whatif,
                           metrics=metrics,
                           devobs=devobs,
                           trace=trace)

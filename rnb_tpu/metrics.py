@@ -645,6 +645,14 @@ class MetricsRegistry:
         events = self.bridge.ring_events()
         path = os.path.join(self.job_dir,
                             "flight-%d.json" % self.num_dumps)
+        # ranked blocking attribution over the dump's ring window
+        # (rnb_tpu.critpath): the dump names its suspect spans up
+        # front, no separate analysis pass over the events needed
+        try:
+            from rnb_tpu.critpath import rank_ring_events
+            suspects = rank_ring_events(events)
+        except Exception:
+            suspects = []  # an annotation must not lose the dump
         trace_mod.export_events(
             # dropped_events = what the bounded ring evicted: a
             # truncated window must read as truncated, never complete
@@ -652,7 +660,8 @@ class MetricsRegistry:
             extra={"flight_trigger": pending.reason,
                    "flight_detail": pending.detail or {},
                    "flight_t_epoch_s": pending.t,
-                   "metric_window": snapshots})
+                   "metric_window": snapshots,
+                   "critpath": suspects})
         self.num_dumps += 1
         return path
 
@@ -880,6 +889,13 @@ class MetricsRegistry:
                 f.write("%s_count %d\n" % (pn, count))
 
     # -- reporting ----------------------------------------------------
+
+    def final_snapshot(self) -> Optional[dict]:
+        """The last snapshot taken (after :meth:`stop`, the FINAL
+        footing record — identical to metrics.jsonl's last line, so
+        consumers calibrating from it are reproducible offline)."""
+        with self._lock:
+            return self._recent[-1] if self._recent else None
 
     def summary(self) -> Dict[str, int]:
         """Final counters for the ``Metrics:``/``Slo:`` log-meta lines

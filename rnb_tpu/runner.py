@@ -238,6 +238,12 @@ class RunnerContext:
     #: replica step itself
     out_hedges: Optional[Any] = None
     in_hedges: Optional[Any] = None
+    #: critical-path extraction (root 'critpath' config key,
+    #: rnb_tpu.critpath): when True, final-instance summaries opt
+    #: into the `# critpath` table trailer (the job-wide Critpath:
+    #: lines are the launcher's aggregation of the same rows) —
+    #: False keeps reports byte-stable with the earlier schema
+    critpath: bool = False
 
 
 def split_segments(payload, num_segments: int):
@@ -635,6 +641,12 @@ def runner(ctx: RunnerContext) -> None:
         # Phases: line); trace-off reports stay byte-stable
         summary.track_phases = True
         summary.phase_num_skips = NUM_SUMMARY_SKIPS
+    if summary is not None and ctx.critpath:
+        # critpath-enabled runs opt the report into the `# critpath`
+        # trailer (same steady-state skip as the job-wide Critpath:
+        # lines); critpath-off reports stay byte-stable
+        summary.track_critpath = True
+        summary.critpath_num_skips = NUM_SUMMARY_SKIPS
     progress_bar = None
     declared_shapes = None
     controller = None

@@ -265,6 +265,29 @@ META_LINE_REGISTRY = (
               "peak_bytes}} — owners are declared in "
               "memledger.MEM_OWNER_REGISTRY "
               "(devobs-enabled runs only)"),
+    StampSpec("Critpath:", "rnb_tpu/benchmark.py",
+              "critical-path extraction counters (rnb_tpu.critpath): "
+              "requests whose blocking chain was recovered, chain "
+              "segments, worst per-request partition residual in "
+              "microseconds, hedge-won and redispatched completions, "
+              "and the binding stage's critical-path throughput "
+              "bound (bound_step / bound_vps_milli) "
+              "(critpath-enabled runs only; --check re-derives every "
+              "field from the timing tables and holds the partition "
+              "residual under 1 ms per request)"),
+    StampSpec("Critpath stages:", "rnb_tpu/benchmark.py",
+              "JSON per-stage blocking attribution: lanes, per-"
+              "(class) blocked totals/means over steady completions, "
+              "occupied ms and the stage's critical-path throughput "
+              "bound (critpath-enabled runs only)"),
+    StampSpec("Whatif:", "rnb_tpu/benchmark.py",
+              "calibrated queueing-model counters (rnb_tpu.whatif): "
+              "stages calibrated from the metrics plane, whether "
+              "calibration succeeded, the model's self-predicted "
+              "throughput in milli-vps and its bottleneck step "
+              "(whatif-enabled runs only; --check recomputes the "
+              "prediction from metrics.jsonl + the config copy alone "
+              "and holds it to +-1 milli-vps)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry
@@ -280,6 +303,10 @@ TABLE_TRAILER_REGISTRY = (
     StampSpec("padding", "rnb_tpu/telemetry.py",
               "per-instance pad rows shipped with completed requests "
               "(0 under ragged dispatch)"),
+    StampSpec("critpath", "rnb_tpu/telemetry.py",
+              "per-instance blocking-chain totals: microseconds "
+              "blocked per (class, step) segment over steady "
+              "completions (critpath-enabled runs only)"),
 )
 
 
@@ -750,6 +777,17 @@ class TimeCardSummary:
         # trace-off reports stay byte-stable with the earlier schema
         self.track_phases: bool = False
         self.phase_num_skips: int = 0
+        # blocking-chain extraction (rnb_tpu.critpath): the hedge/
+        # redispatch content stamps are captured per completion
+        # unconditionally (cheap ints, like clip_counts) so the
+        # chain aggregation stays hedge-aware, but the `# critpath`
+        # trailer is written only when the executor opts this summary
+        # in (root 'critpath' config key) — earlier reports stay
+        # byte-stable
+        self.track_critpath: bool = False
+        self.critpath_num_skips: int = 0
+        self.hedge_flags: List[bool] = []
+        self.redispatch_counts: List[int] = []
 
     def note_failure(self, reason: str, n: int = 1) -> None:
         """Count a contained permanent failure (excluded from timings)."""
@@ -795,6 +833,13 @@ class TimeCardSummary:
         if pad is not None:
             self.num_pad_tracked += 1
             self.num_pad_rows += int(pad)
+        # claim-ledger stamps (rnb_tpu.health): did the hedge clone
+        # win this completion, and how often was it drained off an
+        # evicted lane — the critical-path aggregation reports both
+        self.hedge_flags.append(
+            bool(getattr(time_card, "hedge_copy", False)))
+        self.redispatch_counts.append(
+            int(getattr(time_card, "redispatched", 0)))
 
     def total_clips(self) -> int:
         """Sum of registered records' ``num_clips`` stamps."""
@@ -884,6 +929,40 @@ class TimeCardSummary:
         return ("# padding pad_rows=%d num_tracked=%d"
                 % (self.num_pad_rows, self.num_pad_tracked))
 
+    def steady_rows(self, num_skips: int = 0):
+        """Yield ``(timings, hedged, redispatched)`` per record after
+        ``num_skips`` — the critical-path aggregation's input
+        (rnb_tpu.critpath.aggregate): each row's stamp mapping plus
+        the claim-ledger content stamps captured at register()."""
+        if not self.keys or len(self.keys) < 2:
+            return
+        columns = [self.summary[key][num_skips:] for key in self.keys]
+        hedges = self.hedge_flags[num_skips:]
+        redisps = self.redispatch_counts[num_skips:]
+        for idx, row in enumerate(zip(*columns)):
+            yield (dict(zip(self.keys, row)),
+                   hedges[idx] if idx < len(hedges) else False,
+                   redisps[idx] if idx < len(redisps) else 0)
+
+    def critpath_line(self) -> Optional[str]:
+        """The ``# critpath ...`` trailer, or None when extraction is
+        off (critpath-disabled runs keep the earlier byte-stable
+        schema) or no steady record decomposed. Microsecond integer
+        totals per ``<class><step>`` segment so the generic
+        ``key=value`` trailer parser reads it unchanged."""
+        if not self.track_critpath:
+            return None
+        from rnb_tpu.critpath import trailer_totals
+        n, totals = trailer_totals(
+            timings for timings, _h, _r
+            in self.steady_rows(self.critpath_num_skips))
+        if not n:
+            return None
+        parts = ["# critpath n=%d" % n]
+        parts.extend("%s_us=%d" % (key, totals[key])
+                     for key in sorted(totals))
+        return " ".join(parts)
+
     def phase_samples(self, num_skips: int = 0):
         """{phase: [per-request milliseconds]} over records after
         ``num_skips`` — the deterministic stamp-only decomposition
@@ -963,3 +1042,6 @@ class TimeCardSummary:
         phases = self.phases_line()
         if phases is not None:
             fp.write(phases + "\n")
+        critpath = self.critpath_line()
+        if critpath is not None:
+            fp.write(critpath + "\n")

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""``make explain``: the explanation plane, asserted end-to-end.
+
+Three legs, matching the PR 14 acceptance criteria:
+
+1. **Critical-path extraction** — a traced ``critpath``-enabled run
+   of the tiny shipped pipeline: the ``Critpath:`` lines appear, the
+   per-request blocking chains partition end-to-end latency (worst
+   residual <= 1 ms), and ``parse_utils --explain`` + ``--check``
+   both exit 0.
+2. **What-if validation against reality** — run the SHIPPED
+   single-replica scale-out arm (configs/rnb-scaleout-r1.json, the
+   same seeded workload ``make multichip`` drives) with the metrics
+   plane on, calibrate the queueing model from that job directory's
+   artifacts ALONE (metrics.jsonl + config copy), and ask it the
+   counterfactual the r4 arm answers empirically: ``replicas: 4`` on
+   step 1. The predicted r4/r1 throughput ratio must land within 25%
+   of the committed MULTICHIP_CONFIGS.json cells' measured ratio —
+   the engine is validated against arms the repo already shipped,
+   not against itself.
+3. **Run-diff attribution** — ``scripts/rnb_diff.py`` on the
+   committed evidence pair ``logs/pr12-dct-ab`` must rank the decode/
+   ingest phase as the top *significant* work-phase delta (the PR 12
+   DCT arm deleted host ingest work; queue-wait phases are
+   backpressure symptoms and must not steal the verdict).
+
+Exit 0 = the plane explains, predicts within tolerance, and
+attributes. ~1 minute; no dataset, no native decoder required.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: leg-1 arm: the tiny shipped config, traced with critpath on
+CRITPATH_BASE = "configs/r2p1d-tiny.json"
+CRITPATH_VIDEOS = 24
+
+#: leg-2 arms: the shipped scale-out pair `make multichip` drives,
+#: same seeded saturating workload
+R1_ARM = "configs/rnb-scaleout-r1.json"
+R4_KEY = "configs/rnb-scaleout-r4.json"
+R1_KEY = "configs/rnb-scaleout-r1.json"
+NUM_VIDEOS = 12
+SEED = 17
+#: acceptance tolerance: predicted r4/r1 ratio vs the committed cells
+RATIO_TOL = 0.25
+
+#: leg-3 evidence pair + the phase the verdict must name
+DIFF_PAIR = ("logs/pr12-dct-ab/yuv420", "logs/pr12-dct-ab/dct")
+DIFF_PHASE = "decode"
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rnb_tpu import whatif as whatif_mod
+    from rnb_tpu.benchmark import run_benchmark
+    import parse_utils
+    import rnb_diff
+
+    failures = []
+
+    with tempfile.TemporaryDirectory(prefix="rnb-explain-") as tmp:
+        # -- leg 1: critical-path extraction --------------------------
+        with open(os.path.join(REPO, CRITPATH_BASE)) as f:
+            raw = json.load(f)
+        raw["trace"] = {"enabled": True, "sample_hz": 20}
+        raw["critpath"] = {"enabled": True}
+        cfg1 = os.path.join(tmp, "explain-critpath.json")
+        with open(cfg1, "w") as f:
+            json.dump(raw, f)
+        res1 = run_benchmark(cfg1, mean_interval_ms=0,
+                             num_videos=CRITPATH_VIDEOS, queue_size=64,
+                             log_base=tmp, print_progress=False,
+                             seed=SEED)
+        if res1.termination_flag != 0:
+            failures.append("critpath arm terminated with flag %d"
+                            % res1.termination_flag)
+        if res1.critpath_requests <= 0:
+            failures.append("critpath arm recovered no blocking "
+                            "chains")
+        if res1.critpath_residual_us_max > 1000:
+            failures.append(
+                "blocking chains failed to partition end-to-end "
+                "latency (worst residual %d us > 1000)"
+                % res1.critpath_residual_us_max)
+        print("critpath: %d request(s), worst residual %d us, bound "
+              "step%d at %.3f videos/s"
+              % (res1.critpath_requests, res1.critpath_residual_us_max,
+                 res1.critpath_bound_step,
+                 res1.critpath_bound_vps_milli / 1000.0))
+        rc = parse_utils.print_explanation(res1.log_dir)
+        if rc != 0:
+            failures.append("parse_utils --explain exited %d on the "
+                            "critpath arm" % rc)
+        for problem in parse_utils.check_job(res1.log_dir):
+            failures.append("critpath --check: %s" % problem)
+
+        # -- leg 2: what-if vs the shipped scale-out arms -------------
+        with open(os.path.join(REPO, R1_ARM)) as f:
+            raw = json.load(f)
+        raw["metrics"] = {"enabled": True, "interval_ms": 200}
+        raw["whatif"] = {"enabled": True}
+        cfg2 = os.path.join(tmp, "explain-r1-whatif.json")
+        with open(cfg2, "w") as f:
+            json.dump(raw, f)
+        res2 = run_benchmark(cfg2, mean_interval_ms=0,
+                             num_videos=NUM_VIDEOS, queue_size=64,
+                             log_base=tmp, print_progress=False,
+                             seed=SEED)
+        if res2.termination_flag != 0:
+            failures.append("r1 whatif arm terminated with flag %d"
+                            % res2.termination_flag)
+        if res2.whatif_calibrated != 1:
+            failures.append("whatif did not calibrate from the r1 "
+                            "arm's telemetry")
+        for problem in parse_utils.check_job(res2.log_dir):
+            failures.append("r1 whatif --check: %s" % problem)
+        # calibrate OFFLINE, from the job dir's artifacts alone —
+        # the same path an operator explaining a cold log walks
+        model = whatif_mod.calibrate_job(res2.log_dir)
+        if model is None or not model.calibrated:
+            failures.append("calibrate_job found nothing to model in "
+                            "the r1 arm's job dir")
+            pred_ratio = 0.0
+        else:
+            answer = model.query({"replicas": {1: 4}})
+            pred_ratio = float(answer["vps_ratio"])
+        with open(os.path.join(REPO, "MULTICHIP_CONFIGS.json")) as f:
+            cells = {row["config"]: float(row["videos_per_sec"] or 0)
+                     for row in json.load(f)["configs"]}
+        committed = cells[R4_KEY] / cells[R1_KEY]
+        rel_err = abs(pred_ratio - committed) / committed
+        print("whatif: r1 measured %.3f v/s; replicas->4 predicts "
+              "%.2fx vs the committed cells' %.2fx (rel err %.1f%%, "
+              "tolerance %d%%)"
+              % (res2.throughput_vps, pred_ratio, committed,
+                 rel_err * 100.0, round(RATIO_TOL * 100)))
+        if rel_err > RATIO_TOL:
+            failures.append(
+                "what-if predicts an r4/r1 ratio of %.3f but the "
+                "committed cells measured %.3f (rel err %.1f%% > "
+                "%d%%)" % (pred_ratio, committed, rel_err * 100.0,
+                           round(RATIO_TOL * 100)))
+
+    # -- leg 3: run-diff attribution on the committed pair ------------
+    report = rnb_diff.diff_jobs(os.path.join(REPO, DIFF_PAIR[0]),
+                                os.path.join(REPO, DIFF_PAIR[1]))
+    for line in rnb_diff.report_lines(report):
+        print(line)
+    if report["top"] != DIFF_PHASE:
+        failures.append(
+            "rnb_diff names %r as the top significant work-phase "
+            "delta on logs/pr12-dct-ab; the PR 12 ingest change is "
+            "%r" % (report["top"], DIFF_PHASE))
+
+    if failures:
+        print("\nexplain demo: FAIL")
+        for failure in failures:
+            print("  - %s" % failure)
+        return 1
+    print("\nexplain demo: OK — chains partition, the counterfactual "
+          "lands within tolerance, the regression names its phase")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

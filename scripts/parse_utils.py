@@ -170,6 +170,32 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["memory_" + key] = int(val)
+        elif line.startswith("Critpath stages:"):
+            # JSON per-stage blocking attribution (rnb_tpu.critpath)
+            # — must be matched before the "Critpath:" prefix below;
+            # critpath-enabled runs only
+            import json
+            meta["critpath_stage_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Critpath:"):
+            # "Critpath: requests=N segments=S residual_us_max=R
+            #  hedged=H redispatched=D bound_step=B
+            #  bound_vps_milli=V" — blocking-chain extraction
+            # counters (rnb_tpu.critpath), critpath-enabled runs
+            # only; --check re-derives every field from the timing
+            # tables and holds the partition residual under 1 ms
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["critpath_" + key] = int(val)
+        elif line.startswith("Whatif:"):
+            # "Whatif: stages=N calibrated=C pred_vps_milli=P
+            #  bottleneck_step=B" — calibrated queueing-model
+            # counters (rnb_tpu.whatif), whatif-enabled runs only;
+            # --check recomputes the prediction from metrics.jsonl +
+            # the config copy alone
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["whatif_" + key] = int(val)
         elif line.startswith("Phases:"):
             # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
             # latency attribution over steady-state completions,
@@ -598,6 +624,86 @@ def print_attribution(job_dir: str, out=None) -> int:
     return 0 if worst <= 1.0 else 1
 
 
+# -- critical-path explanation (CLI: --explain <job_dir>) --------------
+
+def print_explanation(job_dir: str, out=None) -> int:
+    """``--explain``: the per-request blocking-chain ranking, the
+    per-stage critical-path throughput bounds, and (when the job
+    streamed metrics) the calibrated what-if counterfactuals — all
+    recomputed from the artifacts alone, so it works on any job dir.
+    Returns 0 on success, 1 when the partition invariant fails or
+    nothing decomposes."""
+    import sys as _sys
+    out = out or _sys.stdout
+    critpath = _rnb_critpath()
+    num_skips = _summary_skips()
+    tables = _timing_tables(job_dir)
+    report = _recompute_critpath(job_dir, tables, num_skips)
+    if report is None:
+        # short runs (fewer rows than the steady skip) still explain
+        # — over every completed row, flagged as such
+        report = _recompute_critpath(job_dir, tables, 0)
+        if report is None:
+            out.write("%s: no completed request decomposes into a "
+                      "blocking chain\n" % job_dir)
+            return 1
+        out.write("%s: fewer rows than the steady-state skip — "
+                  "explaining over every completed request\n"
+                  % job_dir)
+    out.write("%s: blocking-chain attribution over %d request(s)\n"
+              % (job_dir, report["requests"]))
+    out.write("  ranked blocked time (segment = <class><step>):\n")
+    ranked = critpath.ranking(report["stage_detail"])
+    total_all = sum(total for _seg, total, _mean in ranked) or 1.0
+    for seg, total, mean in ranked:
+        out.write("    %-18s %10.2f ms total  %8.3f ms/req  (%4.1f%%)\n"
+                  % (seg, total, mean, 100.0 * total / total_all))
+    out.write("  per-stage critical-path throughput bound "
+              "(lanes x requests / occupied s):\n")
+    for step_key in sorted(report["stage_detail"]):
+        entry = report["stage_detail"][step_key]
+        out.write("    %-8s lanes=%d occupied=%.1f ms  bound=%.3f "
+                  "videos/s%s\n"
+                  % (step_key, entry["lanes"], entry["occupied_ms"],
+                     entry["bound_vps"],
+                     "  <- binding" if ("step%d"
+                                        % report["bound_step"])
+                     == step_key else ""))
+    out.write("  partition residual: worst %d us per request "
+              "(must stay <= 1000)\n" % report["residual_us_max"])
+    # cross-foot the log-meta line when the run wrote one
+    meta = parse_meta(job_dir)
+    status = 0
+    if "critpath_requests" in meta \
+            and meta.get("critpath_requests") != report["requests"]:
+        out.write("  WARNING: log-meta 'Critpath:' counts %s "
+                  "request(s) but the tables recompute %d\n"
+                  % (meta.get("critpath_requests"),
+                     report["requests"]))
+        status = 1
+    # the what-if face: calibrate from the artifacts when present
+    _rnb_trace()
+    from rnb_tpu import whatif as whatif_mod
+    model = whatif_mod.calibrate_job(job_dir)
+    if model is not None and model.calibrated:
+        vps, bottleneck = model.predict_throughput()
+        out.write("  what-if (calibrated from metrics.jsonl + config "
+                  "copy):\n")
+        out.write("    self-predicted %.3f videos/s, bottleneck "
+                  "step%d\n" % (vps, bottleneck))
+        for label, spec in (
+                ("replicas+1 on the bottleneck",
+                 {"replicas": {bottleneck: "+1"}}),
+                ("service x0.5 on the bottleneck",
+                 {"service_scale": {bottleneck: 0.5}}),
+                ("arrival x1.5", {"arrival_scale": 1.5})):
+            answer = model.query(spec)
+            out.write("    %-32s -> %.3f videos/s (%.2fx)\n"
+                      % (label, answer["pred_vps"],
+                         answer["vps_ratio"]))
+    return max(status, 0 if report["residual_us_max"] <= 1000 else 1)
+
+
 # -- consistency checking (CLI: parse_utils.py --check <job_dir>) ------
 
 def check_job(job_dir: str) -> List[str]:
@@ -897,6 +1003,14 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # sum to the ledger total with peak >= final, and every capture
     # artifact must exist and parse
     problems.extend(_check_devobs(job_dir, meta))
+    # explanation plane (rnb_tpu.critpath / rnb_tpu.whatif): blocking
+    # chains must partition every request's end-to-end span (<= 1 ms
+    # residual, every row of every table), the Critpath: lines and
+    # `# critpath` trailers must re-derive from the tables, and the
+    # Whatif: prediction must recompute from metrics.jsonl + the
+    # config copy alone
+    problems.extend(_check_critpath(job_dir, meta, tables))
+    problems.extend(_check_whatif(job_dir, meta))
     return problems, parse_failed
 
 
@@ -1729,6 +1843,241 @@ def _check_devobs_inner(job_dir: str,
     return problems
 
 
+def _rnb_critpath():
+    """Import :mod:`rnb_tpu.critpath` from the repo checkout this
+    script sits in (same rule as :func:`_rnb_trace`: the chain rules
+    live next to the runtime so online and offline can never
+    diverge)."""
+    _rnb_trace()
+    from rnb_tpu import critpath
+    return critpath
+
+
+def _config_lanes(job_dir: str) -> Dict[int, int]:
+    """{step: executor instances} from the config copy benchmark.py
+    drops into the job dir — delegated to rnb_tpu.whatif's config
+    reader + per-step lane rule so the critpath bound recompute and
+    the what-if calibration can never count lanes differently; {}
+    when no config copy is found."""
+    _rnb_trace()
+    from rnb_tpu import whatif as whatif_mod
+    raw = whatif_mod.job_config(job_dir)
+    if raw is None:
+        return {}
+    return {step: int(info["lanes"]) for step, info
+            in whatif_mod.steps_info_from_config(raw).items()}
+
+
+def _parsed_tables(tables: List[str]):
+    """[(path, DataFrame)] for the tables that parse — the shared
+    one-parse input of the critpath recompute + partition loop."""
+    out = []
+    for path in tables:
+        try:
+            out.append((path, parse_timing_table(path)))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _recompute_critpath(job_dir: str, tables: List[str],
+                        num_skips: int, parsed=None):
+    """The offline twin of the launcher's Critpath: aggregation:
+    blocking chains over every table's steady rows (hedge/redispatch
+    content stamps are not persisted in tables, so those two counters
+    stay run-side-only). ``parsed`` reuses already-parsed frames
+    (one parse per table in the composed --check path). -> aggregate
+    report or None."""
+    critpath = _rnb_critpath()
+    if parsed is None:
+        parsed = _parsed_tables(tables)
+
+    def rows():
+        for _path, df in parsed:
+            time_cols = _table_time_cols(df)
+            for row in df.iloc[num_skips:][time_cols].itertuples(
+                    index=False):
+                timings = {k: t for k, t in zip(time_cols, row)
+                           if t == t}
+                if len(timings) >= 2:
+                    yield (timings, False, 0)
+
+    return critpath.aggregate(rows(), _config_lanes(job_dir))
+
+
+def _check_critpath(job_dir: str, meta: Dict[str, object],
+                    tables: List[str]) -> List[str]:
+    problems: List[str] = []
+    try:
+        critpath = _rnb_critpath()
+        num_skips = _summary_skips()
+    except Exception as e:  # noqa: BLE001 — surfaced, not hidden
+        return ["critpath check unavailable (rnb_tpu unimportable): "
+                "%s" % e]
+    # partition invariant over EVERY row of every table (warm records
+    # included), on ANY job dir: the blocking chain must sum to the
+    # end-to-end span within 1 ms. Like the phases twin above, this
+    # guards the EXTRACTOR, not the data — the sum telescopes only
+    # while blocking_chain keeps every adjacent gap, so a future
+    # classifier change that drops/filters segments fails here on
+    # every existing log instead of silently under-attributing
+    saw_critpath_trailer = False
+    parsed = _parsed_tables(tables)  # unparsable: reported above
+    for path, df in parsed:
+        base = os.path.basename(path)
+        time_cols = _table_time_cols(df)
+        for row in df[time_cols].itertuples(index=False):
+            timings = {k: t for k, t in zip(time_cols, row) if t == t}
+            if len(timings) < 2:
+                continue
+            chain = critpath.blocking_chain(timings)
+            e2e_ms = (max(timings.values())
+                      - min(timings.values())) * 1e3
+            total = sum(ms for _c, _s, ms in chain)
+            if abs(total - e2e_ms) > 1.0:
+                problems.append(
+                    "%s: a request's blocking chain sums to %.3f ms "
+                    "but its end-to-end latency is %.3f ms (chain "
+                    "segments must partition the span)"
+                    % (base, total, e2e_ms))
+                break  # one report per table is enough
+        trailer = parse_table_trailers(path).get("critpath")
+        if trailer is None:
+            continue
+        saw_critpath_trailer = True
+        n, totals = critpath.trailer_totals(
+            {k: t for k, t in zip(time_cols, row) if t == t}
+            for row in df.iloc[num_skips:][time_cols].itertuples(
+                index=False))
+        if trailer.get("n") != n:
+            problems.append(
+                "%s: '# critpath' trailer says n=%s but the table "
+                "holds %d steady decomposable row(s)"
+                % (base, trailer.get("n"), n))
+        for key, want in sorted(totals.items()):
+            got = trailer.get("%s_us" % key)
+            if got is None or abs(got - want) > 1000:
+                problems.append(
+                    "%s: '# critpath' trailer %s_us=%s but the "
+                    "table's rows recompute to %d"
+                    % (base, key, got, want))
+    if "critpath_requests" not in meta:
+        if "critpath_stage_detail" in meta:
+            problems.append("log-meta carries a 'Critpath stages:' "
+                            "line but no 'Critpath:' totals line")
+        if saw_critpath_trailer:
+            problems.append("tables carry a '# critpath' trailer but "
+                            "log-meta has no 'Critpath:' line")
+        return problems
+    if not saw_critpath_trailer and tables:
+        problems.append("log-meta carries a 'Critpath:' line but no "
+                        "table carries a '# critpath' trailer")
+    for key in ("critpath_requests", "critpath_segments",
+                "critpath_hedged", "critpath_redispatched",
+                "critpath_bound_vps_milli"):
+        if meta.get(key, 0) < 0:
+            problems.append("negative %s" % key)
+    if meta.get("critpath_residual_us_max", 0) > 1000:
+        problems.append(
+            "critpath_residual_us_max=%d exceeds 1000 us — a "
+            "request's blocking chain failed to partition its "
+            "end-to-end span" % meta["critpath_residual_us_max"])
+    if meta.get("critpath_hedged", 0) > meta.get("critpath_requests",
+                                                 0):
+        problems.append(
+            "critpath_hedged=%d exceeds critpath_requests=%d (a "
+            "hedge-won completion is still one completion)"
+            % (meta["critpath_hedged"], meta["critpath_requests"]))
+    recomputed = _recompute_critpath(job_dir, tables, num_skips,
+                                     parsed=parsed)
+    if recomputed is None:
+        problems.append("log-meta carries a 'Critpath:' line but no "
+                        "table row decomposes into a blocking chain")
+        return problems
+    for key in ("requests", "segments", "bound_step"):
+        if meta.get("critpath_" + key) != recomputed[key]:
+            problems.append(
+                "'Critpath:' %s=%s but the tables recompute %s"
+                % (key, meta.get("critpath_" + key), recomputed[key]))
+    if abs(meta.get("critpath_bound_vps_milli", 0)
+           - recomputed["bound_vps_milli"]) > 1:
+        problems.append(
+            "'Critpath:' bound_vps_milli=%s but the tables recompute "
+            "%d" % (meta.get("critpath_bound_vps_milli"),
+                    recomputed["bound_vps_milli"]))
+    detail = {key: dict(val) for key, val
+              in dict(meta.get("critpath_stage_detail", {})).items()}
+    want_detail = recomputed["stage_detail"]
+    if set(detail) != set(want_detail):
+        problems.append(
+            "'Critpath stages:' names %s but the tables recompute %s"
+            % (sorted(detail), sorted(want_detail)))
+        return problems
+    for step_key in sorted(detail):
+        got, want = detail[step_key], want_detail[step_key]
+        got_classes = dict(got.get("classes", {}))
+        want_classes = dict(want.get("classes", {}))
+        if set(got_classes) != set(want_classes):
+            problems.append(
+                "'Critpath stages:' %s classes %s but the tables "
+                "recompute %s" % (step_key, sorted(got_classes),
+                                  sorted(want_classes)))
+            continue
+        for cls in sorted(want_classes):
+            for stat in ("total_ms", "mean_ms"):
+                got_v = dict(got_classes[cls]).get(stat)
+                want_v = dict(want_classes[cls])[stat]
+                if got_v is None or abs(float(got_v)
+                                        - float(want_v)) > 0.005:
+                    problems.append(
+                        "'Critpath stages:' %s %s %s=%s but the "
+                        "tables recompute %.3f"
+                        % (step_key, cls, stat, got_v, want_v))
+    return problems
+
+
+def _check_whatif(job_dir: str, meta: Dict[str, object]) -> List[str]:
+    problems: List[str] = []
+    if "whatif_stages" not in meta:
+        return problems
+    if meta.get("whatif_calibrated") not in (0, 1):
+        problems.append("whatif_calibrated must be 0 or 1, got %s"
+                        % meta.get("whatif_calibrated"))
+    if "metrics_snapshots" not in meta:
+        problems.append("log-meta carries a 'Whatif:' line but no "
+                        "'Metrics:' line — the what-if engine "
+                        "calibrates from the metrics plane")
+        return problems
+    if meta.get("whatif_calibrated") != 1:
+        if meta.get("whatif_pred_vps_milli", 0) != 0:
+            problems.append(
+                "whatif_pred_vps_milli=%s with calibrated=0 (an "
+                "uncalibrated model must not predict)"
+                % meta.get("whatif_pred_vps_milli"))
+        return problems
+    # reproducibility: the line must recompute from the artifacts
+    # alone (metrics.jsonl final snapshot + config copy)
+    _rnb_trace()
+    from rnb_tpu import whatif as whatif_mod
+    model = whatif_mod.calibrate_job(job_dir)
+    recomputed = whatif_mod.summary_counters(model)
+    for key in ("stages", "calibrated", "bottleneck_step"):
+        if meta.get("whatif_" + key) != recomputed[key]:
+            problems.append(
+                "'Whatif:' %s=%s but metrics.jsonl + the config copy "
+                "recompute %s (the explanation must be reproducible "
+                "from the artifacts)" % (key, meta.get("whatif_" + key),
+                                         recomputed[key]))
+    if abs(meta.get("whatif_pred_vps_milli", 0)
+           - recomputed["pred_vps_milli"]) > 1:
+        problems.append(
+            "'Whatif:' pred_vps_milli=%s but metrics.jsonl + the "
+            "config copy recompute %d"
+            % (meta.get("whatif_pred_vps_milli"),
+               recomputed["pred_vps_milli"]))
+    return problems
+
+
 def _configured_buckets(job_dir: str) -> set:
     """Every row count the job's config could legally warm: the union
     of ``row_buckets`` / ``max_clips`` / ``max_rows`` values across
@@ -1848,6 +2197,13 @@ def main(argv=None) -> int:
                              "per-phase mean/p99 table derived from "
                              "TimeCard stamps alone and verify phases "
                              "sum to end-to-end latency")
+    parser.add_argument("--explain", action="store_true",
+                        help="blocking-chain explanation: ranked "
+                             "blocked time per (class, step) segment, "
+                             "per-stage critical-path throughput "
+                             "bounds, and calibrated what-if "
+                             "counterfactuals when the job streamed "
+                             "metrics")
     args = parser.parse_args(argv)
     if args.stamps:
         print_stamp_registry()
@@ -1856,9 +2212,12 @@ def main(argv=None) -> int:
         parser.error("job_dirs required unless --stamps is given")
     status = 0
     for job_dir in args.job_dirs:
-        # --attribute and --check compose: both run, worst status wins
+        # --attribute/--explain/--check compose: all run, worst
+        # status wins
         if args.attribute:
             status = max(status, print_attribution(job_dir))
+        if args.explain:
+            status = max(status, print_explanation(job_dir))
         if args.check:
             # exit discipline matches the rnb-lint CLI: 2 = the
             # artifacts could not be parsed (the check never ran), 1 =
@@ -1871,7 +2230,7 @@ def main(argv=None) -> int:
                     print("  - %s" % problem)
             else:
                 print("%s: OK" % job_dir)
-        if not args.attribute and not args.check:
+        if not args.attribute and not args.explain and not args.check:
             meta, df = get_data(job_dir)
             print("%s: %d requests" % (job_dir, len(df)))
             for key in sorted(meta):

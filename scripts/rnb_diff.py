@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Run-diff regression attribution: WHY did job B differ from job A.
+
+``scripts/bench_diff.py`` can say a matrix cell regressed;
+``parse_utils --attribute`` can decompose one run — but explaining a
+perf delta between two runs is still a human diffing two phase tables
+by eye. This script closes that gap: given two job directories it
+aligns their per-request phase decompositions (rnb_tpu.trace — the
+stamp-only attribution, so any pair of past logs works), bootstraps
+confidence intervals over the per-phase deltas, and emits a ranked,
+significance-annotated delta table plus a one-line verdict naming the
+top mover.
+
+Reading guide (documented in README "Explanation plane"):
+
+* **Work phases** (decode, hold, transfer, inference{i}, drain) are
+  where compute/IO actually changed — the ranking and the verdict
+  cover these.
+* **Queue phases** (client_queue, inter_stage_queue) are backpressure
+  *symptoms*: under saturation they grow wherever the bottleneck
+  moved, so they are reported in their own section, never as the
+  verdict (a +15 ms queue delta caused by a +2 ms service delta would
+  otherwise headline the wrong suspect).
+* **Paired vs unpaired**: two arms of one seeded A/B complete the
+  same request population, so when per-phase sample counts match the
+  deltas are computed request-by-request in completion order (paired
+  bootstrap — the per-request pairing cancels the load ramp that
+  dominates unpaired variance). Unequal populations fall back to the
+  unpaired difference-of-means bootstrap.
+* Significance = the (default 95%) bootstrap CI of the mean delta
+  excludes zero. A seeded RNG makes every report reproducible.
+
+Exit: 0 = report produced (a delta is information, not a failure),
+2 = a job dir is unreadable/empty. ``bench_diff.py --explain`` calls
+:func:`diff_jobs` on a regressed cell's evidence-log pair so every red
+cell ships with its explanation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: phases that are queueing symptoms, not work — reported separately
+QUEUE_PHASES = ("client_queue", "inter_stage_queue")
+
+DEFAULT_BOOTSTRAPS = 4000
+DEFAULT_SEED = 20260804
+DEFAULT_CI = 95.0
+
+
+def _phase_samples(job_dir: str, num_skips: int
+                   ) -> Tuple[Dict[str, List[float]], List[float]]:
+    """(per-phase samples, per-request end-to-end ms) for one job.
+    End-to-end comes from each row's OWN decomposition — never from
+    zipping the per-phase lists, which would truncate and misalign
+    whenever a request lacks a phase (NaN stamps on union-schema /
+    merged-segment tables make the lists ragged)."""
+    import parse_utils
+    merged: Dict[str, List[float]] = {}
+    e2e: List[float] = []
+    for path in parse_utils._timing_tables(job_dir):
+        df = parse_utils.parse_timing_table(path)
+        for phases, e2e_ms in parse_utils._df_phase_rows(df, num_skips):
+            for phase, ms in phases.items():
+                merged.setdefault(phase, []).append(ms)
+            e2e.append(e2e_ms)
+    return merged, e2e
+
+
+def bootstrap_delta(a: List[float], b: List[float], seed: int,
+                    n_boot: int = DEFAULT_BOOTSTRAPS,
+                    ci: float = DEFAULT_CI
+                    ) -> Dict[str, object]:
+    """Mean delta (b - a) with a bootstrap CI: paired (request-by-
+    request, completion order) when the samples align 1:1, unpaired
+    difference-of-means otherwise. -> {delta_ms, ci_lo, ci_hi,
+    significant, paired, n_a, n_b}."""
+    import numpy as np
+    a_arr = np.asarray(a, dtype=float)
+    b_arr = np.asarray(b, dtype=float)
+    rng = np.random.default_rng(seed)
+    lo_pct = (100.0 - ci) / 2.0
+    hi_pct = 100.0 - lo_pct
+    paired = a_arr.size == b_arr.size and a_arr.size > 0
+    if paired:
+        d = b_arr - a_arr
+        idx = rng.integers(0, d.size, size=(n_boot, d.size))
+        boots = d[idx].mean(axis=1)
+        delta = float(d.mean())
+    else:
+        if a_arr.size == 0 or b_arr.size == 0:
+            return {"delta_ms": 0.0, "ci_lo": 0.0, "ci_hi": 0.0,
+                    "significant": False, "paired": False,
+                    "n_a": int(a_arr.size), "n_b": int(b_arr.size)}
+        idx_a = rng.integers(0, a_arr.size, size=(n_boot, a_arr.size))
+        idx_b = rng.integers(0, b_arr.size, size=(n_boot, b_arr.size))
+        boots = b_arr[idx_b].mean(axis=1) - a_arr[idx_a].mean(axis=1)
+        delta = float(b_arr.mean() - a_arr.mean())
+    ci_lo, ci_hi = (float(v) for v in
+                    np.percentile(boots, [lo_pct, hi_pct]))
+    return {"delta_ms": delta, "ci_lo": ci_lo, "ci_hi": ci_hi,
+            "significant": ci_lo > 0.0 or ci_hi < 0.0,
+            "paired": bool(paired),
+            "n_a": int(a_arr.size), "n_b": int(b_arr.size)}
+
+
+#: job-level context counters worth a line in the report header
+_CONTEXT_KEYS = ("throughput_vps", "wall_time_s", "num_failed",
+                 "num_shed", "cache_hits", "staging_copied_batches",
+                 "deadline_expired")
+
+
+def diff_jobs(job_a: str, job_b: str, num_skips: int = 0,
+              seed: int = DEFAULT_SEED,
+              n_boot: int = DEFAULT_BOOTSTRAPS,
+              ci: float = DEFAULT_CI) -> Dict[str, object]:
+    """The full attribution report for one job pair. Raises OSError/
+    ValueError when a job dir is unreadable. -> {phases: {phase:
+    bootstrap result}, ranking: [work phases, |delta| desc], queue:
+    [queue phases], verdict: str, context: {...}, e2e: bootstrap
+    result}."""
+    import parse_utils
+    meta_a = parse_utils.parse_meta(job_a)
+    meta_b = parse_utils.parse_meta(job_b)
+    samples_a, e2e_a = _phase_samples(job_a, num_skips)
+    samples_b, e2e_b = _phase_samples(job_b, num_skips)
+    if not samples_a or not samples_b:
+        raise ValueError("no per-request phase samples in %s"
+                         % (job_a if not samples_a else job_b))
+    phases: Dict[str, Dict[str, object]] = {}
+    derived_seed = seed
+    for phase in sorted(set(samples_a) | set(samples_b)):
+        phases[phase] = bootstrap_delta(
+            samples_a.get(phase, []), samples_b.get(phase, []),
+            seed=derived_seed, n_boot=n_boot, ci=ci)
+        derived_seed += 1
+    e2e = bootstrap_delta(e2e_a, e2e_b, seed=derived_seed,
+                          n_boot=n_boot, ci=ci)
+    work = sorted((p for p in phases if p not in QUEUE_PHASES),
+                  key=lambda p: (-abs(phases[p]["delta_ms"]), p))
+    queue = sorted((p for p in phases if p in QUEUE_PHASES),
+                   key=lambda p: (-abs(phases[p]["delta_ms"]), p))
+    top = next((p for p in work if phases[p]["significant"]), None)
+    if top is not None:
+        r = phases[top]
+        verdict = ("%s %+.2f ms/req [CI %+.2f, %+.2f] is the top "
+                   "significant work-phase delta (end-to-end %+.2f "
+                   "ms/req)" % (top, r["delta_ms"], r["ci_lo"],
+                                r["ci_hi"], e2e["delta_ms"]))
+    else:
+        verdict = ("no significant work-phase delta (end-to-end "
+                   "%+.2f ms/req)" % e2e["delta_ms"])
+    context = {}
+    for key in _CONTEXT_KEYS:
+        if key in meta_a or key in meta_b:
+            context[key] = (meta_a.get(key), meta_b.get(key))
+    return {"job_a": job_a, "job_b": job_b, "phases": phases,
+            "ranking": work, "queue": queue, "top": top,
+            "verdict": verdict, "e2e": e2e, "context": context,
+            "paired": all(r["paired"] for r in phases.values())}
+
+
+def report_lines(report: Dict[str, object]) -> List[str]:
+    """The human-readable rendering of one :func:`diff_jobs` result."""
+    lines = ["rnb_diff: %s -> %s (%s bootstrap)"
+             % (report["job_a"], report["job_b"],
+                "paired" if report["paired"] else "unpaired")]
+    for key, (va, vb) in sorted(dict(report["context"]).items()):
+        if isinstance(va, float) or isinstance(vb, float):
+            lines.append("  %-22s %s -> %s"
+                         % (key,
+                            "%.3f" % va if va is not None else "-",
+                            "%.3f" % vb if vb is not None else "-"))
+        else:
+            lines.append("  %-22s %s -> %s" % (key, va, vb))
+    phases = dict(report["phases"])
+
+    def row(phase: str) -> str:
+        r = phases[phase]
+        return ("  %-18s %+9.2f ms/req  [CI %+8.2f, %+8.2f]  %s  "
+                "(n=%d/%d)" % (phase, r["delta_ms"], r["ci_lo"],
+                               r["ci_hi"],
+                               "SIG " if r["significant"] else "n.s.",
+                               r["n_a"], r["n_b"]))
+
+    lines.append("work phases (ranked by |delta|):")
+    lines.extend(row(p) for p in report["ranking"])
+    if report["queue"]:
+        lines.append("queue phases (backpressure symptoms, not "
+                     "causes):")
+        lines.extend(row(p) for p in report["queue"])
+    e2e = dict(report["e2e"])
+    lines.append("  %-18s %+9.2f ms/req  [CI %+8.2f, %+8.2f]  %s"
+                 % ("end-to-end", e2e["delta_ms"], e2e["ci_lo"],
+                    e2e["ci_hi"],
+                    "SIG " if e2e["significant"] else "n.s."))
+    lines.append("verdict: %s" % report["verdict"])
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Attribute the perf delta between two job log "
+                    "directories to specific phases, with bootstrap "
+                    "confidence intervals")
+    parser.add_argument("job_a", help="baseline logs/<job> directory")
+    parser.add_argument("job_b", help="candidate logs/<job> directory")
+    parser.add_argument("--skips", type=int, default=0,
+                        help="warm records to skip per table "
+                             "(default 0: diff every completed "
+                             "request)")
+    parser.add_argument("--seed", type=int, default=DEFAULT_SEED,
+                        help="bootstrap RNG seed (reports are "
+                             "reproducible)")
+    parser.add_argument("--bootstraps", type=int,
+                        default=DEFAULT_BOOTSTRAPS)
+    parser.add_argument("--ci", type=float, default=DEFAULT_CI,
+                        help="CI level in percent (default 95)")
+    args = parser.parse_args(argv)
+    try:
+        report = diff_jobs(args.job_a, args.job_b,
+                           num_skips=args.skips, seed=args.seed,
+                           n_boot=args.bootstraps, ci=args.ci)
+    except (OSError, ValueError) as e:
+        print("rnb_diff: cannot diff %s vs %s: %s"
+              % (args.job_a, args.job_b, e))
+        return 2
+    for line in report_lines(report):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,526 @@
+"""Explanation-plane coverage: blocking chains, the what-if engine,
+run-diff attribution, and their end-to-end surfaces.
+
+Unit coverage drives hand-built stamp fixtures with KNOWN blocking
+chains (a hedged winner and a redispatched request included), the
+partition invariant, the ranking/bound arithmetic, the what-if
+calibration against an analytic M/M/1 stream, and the rnb_diff CI
+math on seeded samples; the e2e cases drive the tiny test pipeline
+(tests.pipeline_helpers) through run_benchmark with the root
+``critpath``/``whatif`` keys on and off (byte-stability).
+"""
+
+import json
+import math
+import os
+import sys
+
+import pytest
+
+from rnb_tpu import critpath
+from rnb_tpu.critpath import (CritpathSettings, aggregate,
+                              blocking_chain, chain_totals,
+                              classify_gap, rank_ring_events, ranking,
+                              trailer_totals)
+from rnb_tpu.whatif import (StageCalib, WhatIfModel, WhatifSettings,
+                            calibrate_from_snapshot,
+                            steps_info_from_config, summary_counters)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+# -- settings / config validation -------------------------------------
+
+def test_settings_from_config():
+    assert CritpathSettings.from_config(None) is None
+    assert CritpathSettings.from_config({"enabled": False}) is None
+    assert CritpathSettings.from_config({}).enabled
+    assert WhatifSettings.from_config(None) is None
+    assert WhatifSettings.from_config({"enabled": False}) is None
+    assert WhatifSettings.from_config({}).enabled
+
+
+def _cfg(extra):
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 4},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [1], "in_queue": 0}]},
+        ],
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def test_config_accepts_and_rejects_critpath_key():
+    from rnb_tpu.config import ConfigError, parse_config
+    cfg = parse_config(_cfg({"critpath": {"enabled": True}}))
+    assert cfg.critpath == {"enabled": True}
+    for bad in ("yes", {"enable": True}, {"enabled": 1}):
+        with pytest.raises(ConfigError):
+            parse_config(_cfg({"critpath": bad}))
+
+
+def test_config_whatif_requires_metrics():
+    from rnb_tpu.config import ConfigError, parse_config
+    cfg = parse_config(_cfg({"whatif": {"enabled": True},
+                             "metrics": {"enabled": True}}))
+    assert cfg.whatif == {"enabled": True}
+    with pytest.raises(ConfigError):
+        parse_config(_cfg({"whatif": {"enabled": True}}))
+    with pytest.raises(ConfigError):
+        parse_config(_cfg({"whatif": {"enabled": True},
+                           "metrics": {"enabled": False}}))
+    # disabled whatif without metrics is fine (fully off)
+    assert parse_config(_cfg({"whatif": {"enabled": False}})) \
+        .whatif == {"enabled": False}
+
+
+# -- gap classification / blocking chains ------------------------------
+
+def test_classify_gap_classes_and_steps():
+    assert classify_gap("enqueue_filename", "runner0_start") \
+        == ("queue_wait", 0)
+    assert classify_gap("inference0_finish", "runner1_start") \
+        == ("queue_wait", 1)
+    assert classify_gap("inference0_start", "decode0_done") \
+        == ("decode", 0)
+    assert classify_gap("decode0_done", "transfer0_start") \
+        == ("hold", 0)
+    assert classify_gap("transfer0_start", "transfer0_done") \
+        == ("transfer", 0)
+    assert classify_gap("transfer0_done", "inference0_finish") \
+        == ("drain", 0)
+    assert classify_gap("inference0_start", "inference0_finish") \
+        == ("decode", 0)  # un-refined loader span
+    assert classify_gap("inference1_start", "inference1_finish") \
+        == ("service", 1)
+    # merged segment suffixes are stripped like the phase rules
+    assert classify_gap("inference1_start-0", "inference1_finish-0") \
+        == ("service", 1)
+    # unknown gap: drain at the last known step
+    assert classify_gap("inference2_finish", "mystery_stamp") \
+        == ("drain", 2)
+
+
+#: a refined 2-stage request: every segment length is a distinct
+#: power of two so any misclassification changes a known sum
+REFINED = {
+    "enqueue_filename": 100.0,
+    "runner0_start": 100.001,      # queue_wait0   1 ms
+    "inference0_start": 100.003,   # queue_wait0   2 ms (merged)
+    "decode0_done": 100.007,       # decode0       4 ms
+    "transfer0_start": 100.015,    # hold0         8 ms
+    "transfer0_done": 100.031,     # transfer0    16 ms
+    "inference0_finish": 100.063,  # drain0       32 ms
+    "runner1_start": 100.127,      # queue_wait1  64 ms
+    "inference1_start": 100.255,   # queue_wait1 128 ms (merged)
+    "inference1_finish": 100.511,  # service1    256 ms
+}
+
+
+def test_blocking_chain_known_fixture():
+    chain = blocking_chain(REFINED)
+    assert [(c, s) for c, s, _ms in chain] == [
+        ("queue_wait", 0), ("decode", 0), ("hold", 0),
+        ("transfer", 0), ("drain", 0), ("queue_wait", 1),
+        ("service", 1)]
+    totals = chain_totals(REFINED)
+    assert totals[("queue_wait", 0)] == pytest.approx(3.0, abs=1e-6)
+    assert totals[("queue_wait", 1)] == pytest.approx(192.0, abs=1e-6)
+    assert totals[("service", 1)] == pytest.approx(256.0, abs=1e-6)
+    # partition: segments sum to end-to-end exactly (1+2+...+256)
+    assert sum(ms for _c, _s, ms in chain) \
+        == pytest.approx(511.0, abs=1e-6)
+
+
+def test_blocking_chain_redispatched_request_partitions():
+    # a drained-and-redispatched request re-stamps runner1_start AFTER
+    # its first inference1_start (the sibling lane re-ran it): the
+    # time-ordered walk must still partition the span
+    timings = {
+        "enqueue_filename": 10.0,
+        "runner0_start": 10.001,
+        "inference0_start": 10.002,
+        "inference0_finish": 10.010,
+        "inference1_start": 10.020,
+        "runner1_start": 10.030,   # re-stamped by the redispatch
+        "inference1_finish": 10.050,
+    }
+    chain = blocking_chain(timings)
+    assert sum(ms for _c, _s, ms in chain) \
+        == pytest.approx(50.0, abs=1e-6)
+    # the re-stamped runner1_start re-enters queue_wait1 mid-chain
+    assert ("queue_wait", 1) in {(c, s) for c, s, _ in chain}
+
+
+def test_aggregate_counts_hedged_and_redispatched():
+    rows = [(REFINED, True, 0), (REFINED, False, 2),
+            (REFINED, False, 0)]
+    report = aggregate(rows, {0: 1, 1: 1})
+    assert report["requests"] == 3
+    assert report["hedged"] == 1
+    assert report["redispatched"] == 2
+    assert report["residual_us_max"] == 0
+    assert report["segments"] == 21  # 7 merged segments x 3
+
+
+def test_aggregate_bound_math_and_lanes():
+    # occupied at step1 = service 256 ms/request; 4 lanes over 2
+    # requests -> bound = 4 * 2 / 0.512 s
+    report = aggregate([(REFINED, False, 0), (REFINED, False, 0)],
+                       {0: 1, 1: 4})
+    s1 = report["stage_detail"]["step1"]
+    assert s1["lanes"] == 4
+    assert s1["occupied_ms"] == pytest.approx(512.0, abs=0.01)
+    assert s1["bound_vps"] == pytest.approx(4 * 2 / 0.512, abs=0.01)
+    # step0 occupied = decode 4 + transfer 16 + drain 32 = 52 ms/req
+    s0 = report["stage_detail"]["step0"]
+    assert s0["occupied_ms"] == pytest.approx(104.0, abs=0.01)
+    # step1 is the binding stage (the smaller bound)
+    assert report["bound_step"] == 1
+    assert report["bound_vps_milli"] == round(4 * 2 / 0.512 * 1000)
+
+
+def test_ranking_orders_by_total_blocked_time():
+    report = aggregate([(REFINED, False, 0)], {0: 1, 1: 1})
+    ranked = ranking(report["stage_detail"])
+    assert ranked[0][0] == "service1"  # 256 ms
+    assert ranked[1][0] == "queue_wait1"  # 192 ms
+    names = [name for name, _t, _m in ranked]
+    assert names.index("decode0") > names.index("drain0")
+
+
+def test_trailer_totals_microseconds():
+    n, totals = trailer_totals([REFINED, REFINED])
+    assert n == 2
+    assert totals["service1"] == 512000
+    assert totals["queue_wait0"] == 6000
+
+
+def test_rank_ring_events_span_attribution():
+    events = [("exec1.model_call", "X", 0.0, 0.5, "t", 1, None),
+              ("exec1.model_call", "X", 1.0, 0.25, "t", 2, None),
+              ("exec0.queue_get", "X", 0.0, 0.1, "t", None, None),
+              ("client.enqueue", "i", 0.0, 0.0, "c", 1, None)]
+    ranked = rank_ring_events(events)
+    assert ranked[0] == {"name": "exec1.model_call",
+                         "busy_ms": 750.0, "count": 2}
+    assert [r["name"] for r in ranked] \
+        == ["exec1.model_call", "exec0.queue_get"]
+
+
+# -- what-if engine ----------------------------------------------------
+
+def test_whatif_mm1_recovers_analytic_wait():
+    # M/M/1: lambda = 8/s, mu = 10/s -> Wq = rho / (mu - lambda)
+    # = 0.8 / 2 = 0.4 s. Exponential service: E[S^2] = 2 / mu^2.
+    mu, lam = 10.0, 8.0
+    stage = StageCalib(step=0, lanes=1, dispatches=1000,
+                       service_ms=1000.0 / mu,
+                       service_m2_ms2=2.0 * (1000.0 / mu) ** 2)
+    model = WhatIfModel([stage], requests=1000, wall_s=125.0,
+                        arrival_hz=lam)
+    answer = model.predict_wait_ms(0)
+    assert answer["rho"] == pytest.approx(0.8, abs=1e-9)
+    assert answer["wait_ms"] == pytest.approx(400.0, rel=1e-6)
+    # arrival x1.5 saturates (rho 1.2): the honest answer, not a number
+    hot = model.predict_wait_ms(0, {"arrival_scale": 1.5})
+    assert hot["rho"] == pytest.approx(1.2, abs=1e-9)
+    assert math.isinf(hot["wait_ms"])
+    # service x0.5 halves rho and the P-K wait shrinks accordingly
+    cool = model.predict_wait_ms(0, {"service_scale": {0: 0.5}})
+    assert cool["rho"] == pytest.approx(0.4, abs=1e-9)
+    assert cool["wait_ms"] < answer["wait_ms"]
+
+
+def test_whatif_replica_counterfactual_parallel_service():
+    # one stage, pure lane-parallel service (injected == service):
+    # 4x lanes -> ~4x throughput on a saturated stream
+    stage = StageCalib(step=1, lanes=1, dispatches=12,
+                       service_ms=2000.0, injected_ms=2000.0)
+    model = WhatIfModel([stage], requests=12, wall_s=24.0)
+    base, bstep = model.predict_throughput()
+    assert base == pytest.approx(12 / 24.0, rel=1e-6)
+    assert bstep == 1
+    answer = model.query({"replicas": {"step1": 4}})
+    assert answer["vps_ratio"] == pytest.approx(4.0, rel=0.01)
+    # relative "+3" spells the same query
+    plus = model.query({"replicas": {1: "+3"}})
+    assert plus["pred_vps"] == pytest.approx(answer["pred_vps"],
+                                             rel=1e-9)
+
+
+def test_whatif_host_serial_component_caps_scaling():
+    # half the service is host-serial: lanes overlap the parallel
+    # part but the host component serializes, capping the speedup
+    # well under 4x
+    stage = StageCalib(step=1, lanes=1, dispatches=16,
+                       service_ms=2000.0, injected_ms=1000.0)
+    model = WhatIfModel([stage], requests=16, wall_s=32.0)
+    answer = model.query({"replicas": {1: 4}})
+    assert 1.5 < answer["vps_ratio"] < 2.2  # host bound ~ 1/h = 2x
+
+
+def test_whatif_pool_rows_scales_dispatches():
+    stage = StageCalib(step=1, lanes=1, dispatches=12,
+                       service_ms=1000.0, injected_ms=0.0, rows_cap=3)
+    model = WhatIfModel([stage], requests=12, wall_s=12.0)
+    # doubling the pool halves the dispatch count -> ~2x throughput
+    # (first-order: per-dispatch service held constant)
+    answer = model.query({"pool_rows": 6})
+    assert answer["vps_ratio"] == pytest.approx(2.0, rel=0.01)
+
+
+def test_whatif_calibrate_from_snapshot_and_counters():
+    from rnb_tpu.metrics import hist_bucket, HIST_NUM_BUCKETS
+    buckets = [0] * HIST_NUM_BUCKETS
+    buckets[hist_bucket(2000.0)] = 10
+    snapshot = {
+        "counters": {"slo.tracked": 10},
+        "gauges": {}, "rates": {},
+        "histograms": {
+            "exec1.model_call": {"count": 10, "sum_ms": 20000.0,
+                                 "buckets": buckets},
+            "exec1.device_sync": {"count": 10, "sum_ms": 5000.0,
+                                  "buckets": buckets},
+        },
+    }
+    raw = {"pipeline": [
+        {"queue_groups": [{"devices": [0]}]},
+        {"queue_groups": [{"devices": [1, 2]}]}],
+        "fault_plan": {"faults": [{"kind": "latency", "step": 1,
+                                   "probability": 1.0, "ms": 2000}]},
+        "ragged": {"pool_rows": 3}}
+    info = steps_info_from_config(raw)
+    assert info[1] == {"lanes": 2, "injected_ms": 2000.0,
+                       "rows_cap": 3}
+    # the 'gpus' alias counts lanes exactly like 'devices'
+    alias = steps_info_from_config(
+        {"pipeline": [{"queue_groups": [{"gpus": [0, 1, 2]}]}]})
+    assert alias[0]["lanes"] == 3
+    model = calibrate_from_snapshot(snapshot, info, wall_s=30.0)
+    assert model.calibrated
+    [stage] = model.stages
+    assert stage.step == 1 and stage.lanes == 2
+    assert stage.service_ms == pytest.approx(2500.0)
+    assert stage.injected_ms == 2000.0
+    assert stage.host_ms == pytest.approx(500.0)
+    counters = summary_counters(model)
+    assert counters["calibrated"] == 1 and counters["stages"] == 1
+    assert counters["bottleneck_step"] == 1
+    assert counters["pred_vps_milli"] > 0
+    # nothing calibrated -> zeros, never a fake prediction
+    empty = summary_counters(None)
+    assert empty == {"stages": 0, "calibrated": 0,
+                     "pred_vps_milli": 0, "bottleneck_step": -1}
+
+
+# -- rnb_diff ----------------------------------------------------------
+
+def test_rnb_diff_bootstrap_math_seeded():
+    import rnb_diff
+    import numpy as np
+    rng = np.random.default_rng(5)
+    a = list(rng.normal(100.0, 2.0, size=40))
+    b = [v - 10.0 for v in a]  # paired shift of exactly -10 ms
+    res = rnb_diff.bootstrap_delta(a, b, seed=1)
+    assert res["paired"] is True
+    assert res["delta_ms"] == pytest.approx(-10.0, abs=1e-9)
+    assert res["significant"] and res["ci_hi"] < 0.0
+    # unpaired path: unequal sizes, still significant for a big shift
+    res2 = rnb_diff.bootstrap_delta(a, [v - 10.0 for v in a[:30]],
+                                    seed=1)
+    assert res2["paired"] is False
+    assert res2["significant"]
+    # a pure-noise delta must come out not-significant
+    noise = rnb_diff.bootstrap_delta(a, list(a), seed=2)
+    assert not noise["significant"]
+
+
+def test_rnb_diff_committed_pr12_pair_names_decode():
+    """Acceptance: the committed logs/pr12-dct-ab evidence pair ranks
+    the decode/ingest phase as the top significant delta, with the
+    queue-wait phases reported as backpressure, never the verdict."""
+    import rnb_diff
+    report = rnb_diff.diff_jobs(
+        os.path.join(REPO, "logs", "pr12-dct-ab", "yuv420"),
+        os.path.join(REPO, "logs", "pr12-dct-ab", "dct"))
+    assert report["paired"] is True
+    assert report["top"] == "decode"
+    assert report["phases"]["decode"]["significant"]
+    assert report["phases"]["decode"]["delta_ms"] < 0
+    assert "decode" in report["verdict"]
+    assert "inter_stage_queue" in report["queue"]
+    lines = rnb_diff.report_lines(report)
+    assert any(line.startswith("verdict: decode") for line in lines)
+
+
+def test_rnb_diff_cli_exit_codes(tmp_path):
+    import rnb_diff
+    assert rnb_diff.main([str(tmp_path / "nope-a"),
+                          str(tmp_path / "nope-b")]) == 2
+    assert rnb_diff.main([
+        os.path.join(REPO, "logs", "pr12-dct-ab", "yuv420"),
+        os.path.join(REPO, "logs", "pr12-dct-ab", "dct")]) == 0
+
+
+def test_bench_diff_explain_graceful_without_evidence():
+    import bench_diff
+    base = {"c.json": {"config": "c.json", "ok": True,
+                       "videos_per_sec": 1.0}}
+    cur = {"c.json": {"config": "c.json", "ok": True,
+                      "videos_per_sec": 0.1}}
+    lines, regressions = bench_diff.diff(base, cur, 0.3, explain=True)
+    assert regressions == 1
+    assert any("no explanation" in line and "evidence_logs" in line
+               for line in lines)
+
+
+def test_bench_diff_explain_attributes_with_evidence():
+    import bench_diff
+    base = {"c.json": {"config": "c.json", "ok": True,
+                       "videos_per_sec": 1.0,
+                       "evidence_logs": "logs/pr12-dct-ab/yuv420"}}
+    cur = {"c.json": {"config": "c.json", "ok": True,
+                      "videos_per_sec": 0.1,
+                      "evidence_logs": "logs/pr12-dct-ab/dct"}}
+    lines, regressions = bench_diff.diff(base, cur, 0.3, explain=True)
+    assert regressions == 1
+    assert any("verdict: decode" in line for line in lines)
+    # explain off: the regression stands alone
+    lines_off, _ = bench_diff.diff(base, cur, 0.3)
+    assert not any("verdict" in line for line in lines_off)
+    # both rows pointing at ONE dir (a carried-forward pointer) must
+    # degrade honestly, never print an all-zero "attribution"
+    cur_same = {"c.json": dict(cur["c.json"],
+                               evidence_logs="logs/pr12-dct-ab/yuv420")}
+    lines_same, _ = bench_diff.diff(base, cur_same, 0.3, explain=True)
+    assert any("share the same evidence dir" in line
+               for line in lines_same)
+    assert not any("verdict" in line for line in lines_same)
+
+
+# -- flight-dump annotation --------------------------------------------
+
+def test_flight_dump_carries_critpath_annotation(tmp_path):
+    from rnb_tpu import metrics as metrics_mod
+    registry = metrics_mod.MetricsRegistry(
+        metrics_mod.MetricsSettings(), job_dir=str(tmp_path),
+        job_id="flight-cp")
+    bridge = metrics_mod.SpanBridge(registry, ring_events=64)
+    registry.bridge = bridge
+    bridge.add_event("exec1.model_call", "X", 100.0, 0.25, 1, None)
+    bridge.add_event("exec0.queue_get", "X", 100.3, 0.05, 2, None)
+    registry.request_dump("forced", {"why": "test"})
+    registry.tick()
+    path = str(tmp_path / "flight-0.json")
+    assert os.path.isfile(path)
+    with open(path) as f:
+        doc = json.load(f)
+    suspects = doc["otherData"]["critpath"]
+    assert suspects[0]["name"] == "exec1.model_call"
+    assert suspects[0]["busy_ms"] == pytest.approx(250.0)
+
+
+# -- end-to-end --------------------------------------------------------
+
+def _run(tmp_path, name, extra, videos=30, interval_ms=1):
+    from rnb_tpu.benchmark import run_benchmark
+    cfg = _cfg(extra)
+    path = os.path.join(str(tmp_path), "%s.json" % name)
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return run_benchmark(path, mean_interval_ms=interval_ms,
+                         num_videos=videos, queue_size=50,
+                         log_base=os.path.join(str(tmp_path),
+                                               "logs-%s" % name),
+                         print_progress=False)
+
+
+def test_critpath_e2e_explain_and_check_green(tmp_path):
+    import parse_utils
+    res = _run(tmp_path, "cp",
+               {"trace": {"enabled": True, "sample_hz": 0},
+                "critpath": {"enabled": True}})
+    assert res.termination_flag == 0
+    assert res.critpath_requests > 0
+    assert res.critpath_segments >= res.critpath_requests
+    assert res.critpath_residual_us_max <= 1000
+    assert res.critpath_stage_detail  # per-stage JSON populated
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Critpath: requests=%d" % res.critpath_requests in meta_text
+    assert "Critpath stages:" in meta_text
+    tables = [n for n in os.listdir(res.log_dir) if "group" in n]
+    with open(os.path.join(res.log_dir, tables[0])) as f:
+        assert "# critpath" in f.read()
+    assert parse_utils.print_explanation(res.log_dir) == 0
+    problems = parse_utils.check_job(res.log_dir)
+    assert problems == [], problems
+
+
+def test_whatif_e2e_line_reproducible_offline(tmp_path):
+    import parse_utils
+    from rnb_tpu import whatif as whatif_mod
+    res = _run(tmp_path, "wi",
+               {"metrics": {"enabled": True, "interval_ms": 100,
+                            "flight_recorder": False},
+                "whatif": {"enabled": True}})
+    assert res.termination_flag == 0
+    assert res.whatif_calibrated == 1
+    assert res.whatif_stages >= 1
+    assert res.whatif_pred_vps_milli > 0
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        assert "Whatif: stages=" in f.read()
+    # the line recomputes from the artifacts alone
+    model = whatif_mod.calibrate_job(res.log_dir)
+    recomputed = whatif_mod.summary_counters(model)
+    assert recomputed["calibrated"] == 1
+    assert abs(recomputed["pred_vps_milli"]
+               - res.whatif_pred_vps_milli) <= 1
+    problems = parse_utils.check_job(res.log_dir)
+    assert problems == [], problems
+
+
+def test_check_catches_cooked_critpath_line(tmp_path):
+    import parse_utils
+    res = _run(tmp_path, "cooked",
+               {"trace": {"enabled": True, "sample_hz": 0},
+                "critpath": {"enabled": True}})
+    meta_path = os.path.join(res.log_dir, "log-meta.txt")
+    with open(meta_path) as f:
+        text = f.read()
+    cooked = text.replace(
+        "Critpath: requests=%d" % res.critpath_requests,
+        "Critpath: requests=%d" % (res.critpath_requests + 5))
+    assert cooked != text
+    with open(meta_path, "w") as f:
+        f.write(cooked)
+    problems = parse_utils.check_job(res.log_dir)
+    assert any("'Critpath:' requests=" in p for p in problems), problems
+
+
+def test_feature_off_run_stays_byte_stable(tmp_path):
+    res = _run(tmp_path, "off", {})
+    assert res.termination_flag == 0
+    assert res.critpath_requests == 0 and res.whatif_stages == 0
+    assert res.critpath_stage_detail == {}
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Critpath" not in meta_text and "Whatif" not in meta_text
+    tables = [n for n in os.listdir(res.log_dir) if "group" in n]
+    with open(os.path.join(res.log_dir, tables[0])) as f:
+        report = f.read()
+    assert "# critpath" not in report
+    # the stamp schema is exactly the pre-critpath set
+    header = report.split("\n", 1)[0].split()
+    assert header == ["enqueue_filename", "runner0_start",
+                      "inference0_start", "inference0_finish",
+                      "runner1_start", "inference1_start",
+                      "inference1_finish", "device0", "device1"]

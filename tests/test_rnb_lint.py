@@ -401,6 +401,9 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Compute stages: %s\\n" % cs)\n'
                      'f.write("Memory: owners=%d\\n" % mb)\n'
                      'f.write("Memory owners: %s\\n" % mo)\n'
+                     'f.write("Critpath: requests=%d\\n" % cr)\n'
+                     'f.write("Critpath stages: %s\\n" % ct)\n'
+                     'f.write("Whatif: stages=%d\\n" % wi)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -455,7 +458,12 @@ REPO_BENCH_LIKE = (
         'captures=%d\\n" % cp)\n'
         'f.write("Memory: owners=%d devices=%d total_bytes=%d '
         'peak_bytes=%d watermark_bytes=%d watermark_hits=%d '
-        'live_bytes=%d reconciled=%d\\n" % mm)\n')
+        'live_bytes=%d reconciled=%d\\n" % mm)\n'
+        'f.write("Critpath: requests=%d segments=%d '
+        'residual_us_max=%d hedged=%d redispatched=%d bound_step=%d '
+        'bound_vps_milli=%d\\n" % cr)\n'
+        'f.write("Whatif: stages=%d calibrated=%d pred_vps_milli=%d '
+        'bottleneck_step=%d\\n" % wi)\n')
 
 
 def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
@@ -485,6 +493,27 @@ def test_compute_memory_counter_drift_triggers_t006(tmp_path):
     anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
     assert "compute_bogus_flops" in anchors
     assert "memory_bogus_bytes" in anchors
+
+
+def test_critpath_whatif_counter_drift_triggers_t006(tmp_path):
+    """The RNB-T006 family covers the explanation-plane lines: the
+    good fixture (REPO_BENCH_LIKE, which writes the full Critpath:/
+    Whatif: counter sets) is clean, and a bogus counter on either
+    line surfaces as exactly its drifted field."""
+    from rnb_tpu.analysis.schema import check_benchmark_result
+    good = tmp_path / "good_bench_like.py"
+    good.write_text(REPO_BENCH_LIKE)
+    assert check_benchmark_result(str(good), root=str(tmp_path)) == []
+    bad = tmp_path / "bad_bench_like.py"
+    bad.write_text(REPO_BENCH_LIKE
+                   .replace('bound_vps_milli=%d\\n',
+                            'bound_vps_milli=%d bogus_chain=%d\\n')
+                   .replace('bottleneck_step=%d\\n',
+                            'bottleneck_step=%d bogus_pred=%d\\n'))
+    findings = check_benchmark_result(str(bad), root=str(tmp_path))
+    anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
+    assert "critpath_bogus_chain" in anchors
+    assert "whatif_bogus_pred" in anchors
 
 
 def test_schema_checker_clean_on_repo():
