@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: lint test native stamps trace ragged multichip chaos metrics dct \
-	devobs benchdiff explain
+	devobs benchdiff explain operator
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -99,6 +99,16 @@ benchdiff:
 # the top significant work-phase delta.
 explain:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/explain_demo.py
+
+# Operator-plane gate (README "Operator plane"): a tiny run with the
+# introspection/control server up, scraped WHILE serving — /healthz,
+# /statusz and /metrics answer live, the mid-run scrape cross-foots
+# the teardown exposition on every shared series, a POSTed /flight
+# dump passes validate_trace, the stack sampler's folded counts
+# re-sum to the Stacks: total, parse_utils --check green — plus an
+# operator-off arm proving byte-stable logs.
+operator:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/operator_demo.py
 
 native:
 	$(MAKE) -C native

@@ -26,8 +26,8 @@ Rules
   or trailer kind ``parse_utils`` never checks for.
 * ``RNB-T006`` result-field-drift: a ``key=value`` counter written to
   the Faults:/Cache:/Staging:/Autotune:/Trace:/Ragged:/Handoff:/
-  Padding:/Compute:/Memory:/Critpath:/Whatif: log-meta lines with no
-  matching ``BenchmarkResult`` field (or vice
+  Padding:/Compute:/Memory:/Critpath:/Whatif:/Operator:/Stacks:
+  log-meta lines with no matching ``BenchmarkResult`` field (or vice
   versa for those counter families; dict-valued fields — bucket
   counts, per-edge overflows, compile signatures, warmup seconds —
   ride their own JSON meta lines and are exempt).
@@ -261,7 +261,9 @@ COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Compute:": "compute_",
                          "Memory:": "memory_",
                          "Critpath:": "critpath_",
-                         "Whatif:": "whatif_"}
+                         "Whatif:": "whatif_",
+                         "Operator:": "operator_",
+                         "Stacks:": "stacks_"}
 
 #: verbatim-named counter fields (prefix "") the reverse RNB-T006
 #: direction holds to a meta-line counter — the Faults: trio plus the
@@ -543,7 +545,9 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
                 or field.startswith("compute_") \
                 or field.startswith("memory_") \
                 or field.startswith("critpath_") \
-                or field.startswith("whatif_"):
+                or field.startswith("whatif_") \
+                or field.startswith("operator_") \
+                or field.startswith("stacks_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,

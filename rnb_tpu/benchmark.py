@@ -316,6 +316,25 @@ class BenchmarkResult:
     whatif_calibrated: int = 0
     whatif_pred_vps_milli: int = 0
     whatif_bottleneck_step: int = 0
+    #: operator-plane request ledger (rnb_tpu.statusz, root `operator`
+    #: config key): GET requests served (scrapes), POST actions
+    #: accepted, POST actions denied by the allow_actions gate, and
+    #: request errors (bad route / unavailable backing plane) — all
+    #: zero without the key. --check holds the Operator: line to the
+    #: operator.json artifact's presence both ways.
+    operator_scrapes: int = 0
+    operator_actions: int = 0
+    operator_denied: int = 0
+    operator_errors: int = 0
+    #: wall-clock stack sampler ledger (rnb_tpu.stacksampler, gated on
+    #: `operator.sample_hz` > 0): sampling ticks, distinct thread
+    #: roles, distinct folded stacks, total per-thread samples — the
+    #: stacks.folded artifact's counts sum to stacks_total exactly and
+    #: ticks track sample_hz x wall within --check's tolerance.
+    stacks_samples: int = 0
+    stacks_threads: int = 0
+    stacks_folded: int = 0
+    stacks_total: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -527,6 +546,27 @@ def run_benchmark(config_path: str,
         effective_queue_size = (num_videos * seg_factor + num_runners
                                 + max(NUM_EXIT_MARKERS, num_runners) + 1)
     fabric = ChannelFabric(config, effective_queue_size)
+    # one queue-occupancy probe list — (series name, qsize fn,
+    # capacity) per edge in step-major enumeration order — shared by
+    # the metrics gauge sources and the operator server's /statusz so
+    # their edge naming can never diverge. The trace block below
+    # keeps its own enumeration of the SAME edges in the SAME order
+    # only because RNB-T008/T009 each require literal trace.name/
+    # metrics.name call sites for their registries — any change to
+    # this walk must be mirrored there
+    queue_probes = [(metrics_mod.name("queue.filename.depth"),
+                     fabric.get_filename_queue().qsize,
+                     effective_queue_size)]
+    _edge_idx = 0
+    for _step_queues in fabric.queues:
+        # edge ordinal in step-major enumeration order (queue indices
+        # may legally repeat across steps, so the ordinal — not the
+        # config's queue index — keys the series)
+        for _q_idx in sorted(_step_queues):
+            queue_probes.append(
+                (metrics_mod.name("queue.e%d.depth", _edge_idx),
+                 _step_queues[_q_idx].qsize, effective_queue_size))
+            _edge_idx += 1
 
     # unified pipeline tracing (rnb_tpu.trace, root 'trace' config
     # key): one per-job collector every thread role records spans
@@ -537,6 +577,9 @@ def run_benchmark(config_path: str,
     trace_settings = trace_mod.TraceSettings.from_config(config.trace)
     if trace_settings is not None:
         tracer = trace_mod.Tracer(trace_settings)
+        # mirrors the shared queue_probes walk above (same edges, same
+        # step-major ordinal naming); kept as explicit trace.name
+        # sites because RNB-T008 requires the literals here
         tracer.add_counter_source(
             trace_mod.name("queue.filename.depth"),
             fabric.get_filename_queue().qsize)
@@ -574,18 +617,9 @@ def run_benchmark(config_path: str,
         metrics_registry = metrics_mod.MetricsRegistry(
             metrics_settings, job_dir=logroot(job_id, base=log_base),
             job_id=job_id, slo_budget_ms=slo_budget)
-        metrics_registry.add_gauge_source(
-            metrics_mod.name("queue.filename.depth"),
-            fabric.get_filename_queue().qsize,
-            capacity=effective_queue_size)
-        edge_idx = 0
-        for step_queues in fabric.queues:
-            for q_idx in sorted(step_queues):
-                metrics_registry.add_gauge_source(
-                    metrics_mod.name("queue.e%d.depth", edge_idx),
-                    step_queues[q_idx].qsize,
-                    capacity=effective_queue_size)
-                edge_idx += 1
+        for probe_name, probe_fn, probe_cap in queue_probes:
+            metrics_registry.add_gauge_source(probe_name, probe_fn,
+                                              capacity=probe_cap)
         metrics_registry.add_poll(metrics_mod.snapshot_poll(
             "faults", fault_stats.snapshot,
             counters=("num_failed", "num_shed", "num_retries")))
@@ -645,6 +679,45 @@ def run_benchmark(config_path: str,
     from rnb_tpu.whatif import WhatifSettings
     critpath_settings = CritpathSettings.from_config(config.critpath)
     whatif_settings = WhatifSettings.from_config(config.whatif)
+
+    # the operator plane (rnb_tpu.statusz / rnb_tpu.stacksampler, root
+    # 'operator' config key): a threaded loopback HTTP server over the
+    # registries built above — /healthz (lane boards), /metrics (the
+    # live Prometheus exposition), /statusz, /whatif (the calibrated
+    # counterfactual, live), /stacks, and allow_actions-gated POST
+    # /flight and /capture — plus a continuous wall-clock stack
+    # sampler over the named pipeline threads (sample_hz > 0). Bound
+    # address lands in logs/<job>/operator.json; nothing here measures
+    # anything new, it only serves what the planes already hold.
+    from rnb_tpu.statusz import OperatorServer, OperatorSettings
+    operator_settings = OperatorSettings.from_config(config.operator)
+    operator_server = None
+    stack_sampler = None
+    operator_window: Dict[str, Any] = {"t0": None}
+    if operator_settings is not None:
+        if operator_settings.sample_hz > 0:
+            from rnb_tpu.stacksampler import StackSampler
+            stack_sampler = StackSampler(operator_settings.sample_hz)
+        topology = {"steps": [
+            {"step": step_idx, "model": step.model,
+             "groups": len(step.groups),
+             "instances": sum(len(g.devices) for g in step.groups),
+             "replica_lanes": list(step.replica_queues or [])}
+            for step_idx, step in enumerate(config.steps)]}
+        operator_server = OperatorServer(
+            operator_settings, job_dir=logroot(job_id, base=log_base),
+            job_id=job_id, metrics_registry=metrics_registry,
+            boards=boards_by_step, devobs_plane=devobs_plane,
+            config_raw=config.raw, topology=topology,
+            queue_probes=queue_probes, termination=termination,
+            window=operator_window, sampler=stack_sampler)
+        operator_server.start()
+        if print_progress:
+            print("[rnb-tpu] operator server on http://127.0.0.1:%d "
+                  "(actions %s)"
+                  % (operator_server.port,
+                     "enabled" if operator_settings.allow_actions
+                     else "disabled"))
 
     threads = []
     client_kwargs = dict(overload_policy=config.overload_policy,
@@ -829,6 +902,15 @@ def run_benchmark(config_path: str,
     if devobs_plane is not None:
         devobs_plane.note_run_started()
     time_start = time.time()
+    # the operator server's measured-window clock (/whatif wall_s,
+    # /statusz) starts ticking with the window itself
+    operator_window["t0"] = time_start
+    if stack_sampler is not None:
+        # the wall-clock sampler covers the measured window (plus the
+        # short drain to thread join) — started AFTER the barrier so
+        # multi-minute warmup compiles never land in the folded
+        # stacks and the samples ~ sample_hz x wall invariant holds
+        stack_sampler.start()
     if print_progress:
         print("START! %f" % time_start)
 
@@ -905,6 +987,20 @@ def run_benchmark(config_path: str,
         if tracer is not None:
             tracer.extend(devobs_plane.device_events(
                 devobs_mod.model_call_spans(tracer.snapshot_events())))
+
+    # wall-clock stack sampler: stop, write the flamegraph-folded
+    # artifact, and merge the per-role top-frame timeline into the
+    # tracer as stacks:<role> tracks BEFORE the export below writes
+    # trace.json (the devobs device-track pattern)
+    stacks_summary = None
+    if stack_sampler is not None:
+        stack_sampler.stop()
+        stack_sampler.write_folded(
+            os.path.join(logroot(job_id, base=log_base),
+                         "stacks.folded"))
+        if tracer is not None:
+            tracer.extend(stack_sampler.trace_events())
+        stacks_summary = stack_sampler.summary()
 
     # trace export: every thread is drained, so the event set is
     # final; clear the module hook BEFORE exporting so a later run in
@@ -1027,6 +1123,15 @@ def run_benchmark(config_path: str,
         metrics_registry.stop()
         metrics_mod.ACTIVE = None
         metrics_summary = metrics_registry.summary()
+
+    operator_summary = None
+    if operator_server is not None:
+        # the server outlives the pipeline into teardown (a live
+        # scraper may still read the settling /metrics state), and
+        # stops before the log-meta write so the Operator: ledger
+        # below is final
+        operator_server.stop()
+        operator_summary = operator_server.summary()
 
     # what-if engine calibration (rnb_tpu.whatif): built from the
     # FINAL metrics snapshot — the same dict metrics.jsonl holds as
@@ -1315,6 +1420,26 @@ def run_benchmark(config_path: str,
                        whatif_counters["calibrated"],
                        whatif_counters["pred_vps_milli"],
                        whatif_counters["bottleneck_step"]))
+        if operator_summary is not None:
+            # only operator-enabled runs carry the line (logs stay
+            # byte-stable otherwise); --check holds it to the
+            # operator.json artifact both ways
+            f.write("Operator: scrapes=%d actions=%d denied=%d "
+                    "errors=%d\n"
+                    % (operator_summary["scrapes"],
+                       operator_summary["actions"],
+                       operator_summary["denied"],
+                       operator_summary["errors"]))
+        if stacks_summary is not None:
+            # operator runs with sample_hz > 0 only; the stacks.folded
+            # counts sum to total and samples track sample_hz x wall
+            # (--check invariants)
+            f.write("Stacks: samples=%d threads=%d folded=%d "
+                    "total=%d\n"
+                    % (stacks_summary["samples"],
+                       stacks_summary["threads"],
+                       stacks_summary["folded"],
+                       stacks_summary["total"]))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -1475,6 +1600,18 @@ def run_benchmark(config_path: str,
                  whatif_counters["calibrated"],
                  whatif_counters["pred_vps_milli"] / 1000.0,
                  whatif_counters["bottleneck_step"]))
+    if operator_summary is not None and print_progress:
+        print("Operator: %d scrape(s), %d action(s), %d denied, "
+              "%d error(s)"
+              % (operator_summary["scrapes"],
+                 operator_summary["actions"],
+                 operator_summary["denied"],
+                 operator_summary["errors"]))
+    if stacks_summary is not None and print_progress:
+        print("Stacks: %d tick(s) over %d role(s) -> %d folded "
+              "stack(s) (%d samples) in stacks.folded"
+              % (stacks_summary["samples"], stacks_summary["threads"],
+                 stacks_summary["folded"], stacks_summary["total"]))
 
     if hostprof.ENABLED:
         lines = hostprof.report_lines(total_time)
@@ -1673,6 +1810,22 @@ def run_benchmark(config_path: str,
                                if whatif_counters else 0),
         whatif_bottleneck_step=(whatif_counters["bottleneck_step"]
                                 if whatif_counters else 0),
+        operator_scrapes=(operator_summary["scrapes"]
+                          if operator_summary else 0),
+        operator_actions=(operator_summary["actions"]
+                          if operator_summary else 0),
+        operator_denied=(operator_summary["denied"]
+                         if operator_summary else 0),
+        operator_errors=(operator_summary["errors"]
+                         if operator_summary else 0),
+        stacks_samples=(stacks_summary["samples"]
+                        if stacks_summary else 0),
+        stacks_threads=(stacks_summary["threads"]
+                        if stacks_summary else 0),
+        stacks_folded=(stacks_summary["folded"]
+                       if stacks_summary else 0),
+        stacks_total=(stacks_summary["total"]
+                      if stacks_summary else 0),
     )
 
 
@@ -1777,6 +1930,9 @@ def main(argv=None) -> int:
                  if cfg.critpath else "none",
                  json.dumps(cfg.whatif, sort_keys=True)
                  if cfg.whatif else "none"))
+        print("operator: %s"
+              % (json.dumps(cfg.operator, sort_keys=True)
+                 if cfg.operator else "none"))
         hedged = {"step%d" % i: s.hedge_ms
                   for i, s in enumerate(cfg.steps)
                   if s.hedge_ms is not None}

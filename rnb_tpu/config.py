@@ -38,7 +38,7 @@ ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
     "trace", "ragged", "handoff", "placement", "health", "deadline",
-    "metrics", "devobs", "critpath", "whatif", "_comment",
+    "metrics", "devobs", "critpath", "whatif", "operator", "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -85,6 +85,9 @@ CRITPATH_KEYWORDS = ["enabled"]
 
 #: keys a root 'whatif' object may carry (rnb_tpu.whatif)
 WHATIF_KEYWORDS = ["enabled"]
+
+#: keys a root 'operator' object may carry (rnb_tpu.statusz)
+OPERATOR_KEYWORDS = ["enabled", "port", "allow_actions", "sample_hz"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -270,6 +273,17 @@ class PipelineConfig:
     #: model at teardown (rnb_tpu.whatif) and log-meta gains the
     #: Whatif: line. Absent => byte-stable logs.
     whatif: Optional[Dict[str, Any]] = None
+    #: validated operator-plane spec ({"enabled": .., "port": ..,
+    #: "allow_actions": .., "sample_hz": ..}), or None; when enabled
+    #: the launcher binds the rnb_tpu.statusz introspection/control
+    #: HTTP server on loopback (port 0 = ephemeral; bound address
+    #: written to logs/<job>/operator.json) and — with sample_hz > 0 —
+    #: runs the rnb_tpu.stacksampler wall-clock stack sampler
+    #: (stacks.folded artifact, sampler tracks in trace.json, Stacks:
+    #: line). POST actions (/flight, /capture) stay 403 unless
+    #: allow_actions is true. Absent => no server, no sampler,
+    #: byte-stable logs.
+    operator: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -779,6 +793,33 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                     "— the per-stage service histograms streamed to "
                     "metrics.jsonl are the calibration data")
 
+    operator = raw.get("operator")
+    if operator is not None:
+        _expect(isinstance(operator, dict),
+                "'operator' must be an object")
+        unknown_op = sorted(set(operator) - set(OPERATOR_KEYWORDS))
+        _expect(not unknown_op,
+                "'operator' has unknown key(s) %s — keys are %s"
+                % (unknown_op, OPERATOR_KEYWORDS))
+        _expect(isinstance(operator.get("enabled", True), bool),
+                "'operator.enabled' must be a boolean")
+        _expect(isinstance(operator.get("allow_actions", False), bool),
+                "'operator.allow_actions' must be a boolean (false "
+                "keeps POST /flight and /capture 403-gated)")
+        port = operator.get("port", 0)
+        _expect(isinstance(port, int) and not isinstance(port, bool)
+                and 0 <= port <= 65535,
+                "'operator.port' must be an integer in [0, 65535] "
+                "(0 binds an ephemeral port, recorded in "
+                "operator.json), got %r" % (port,))
+        op_hz = operator.get("sample_hz")
+        _expect(op_hz is None
+                or (isinstance(op_hz, (int, float))
+                    and not isinstance(op_hz, bool) and op_hz >= 0),
+                "'operator.sample_hz' must be a non-negative number "
+                "(0 disables the wall-clock stack sampler), got %r"
+                % (op_hz,))
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -992,4 +1033,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           whatif=whatif,
                           metrics=metrics,
                           devobs=devobs,
+                          operator=operator,
                           trace=trace)

@@ -218,6 +218,16 @@ class MemLedger:
                     pass  # an observer must not break the sampler
         return record
 
+    def peek(self) -> "Optional[dict]":
+        """The most recent :meth:`sample` record WITHOUT probing —
+        no peak/num_samples updates, no watermark evaluation, no
+        trigger side effects. The operator plane's read
+        (rnb_tpu.statusz /statusz): an ungated GET must never mutate
+        ledger state or fire actuation hooks. None until the devobs
+        worker has sampled once."""
+        with self._lock:
+            return self._last
+
     # -- reconciliation ------------------------------------------------
 
     @staticmethod

@@ -796,12 +796,24 @@ class MetricsRegistry:
 
     def tick(self, now: Optional[float] = None) -> dict:
         """One flusher iteration: snapshot, append to metrics.jsonl,
-        service pending flight dumps. Public so tests (and the final
-        flush) drive it without the thread."""
+        refresh the Prometheus exposition file, service pending flight
+        dumps. Public so tests (and the final flush) drive it without
+        the thread."""
         record = self.snapshot(now)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(record, sort_keys=True) + "\n")
             self._jsonl.flush()
+        if self.job_dir is not None:
+            # live exposition on EVERY flush interval (not just
+            # teardown), written atomically so a file-based scraper
+            # can never read a torn exposition — the file twin of the
+            # operator server's GET /metrics (rnb_tpu.statusz), which
+            # serves the same renderer
+            try:
+                self._write_exposition(
+                    os.path.join(self.job_dir, "metrics.prom"))
+            except OSError:
+                pass  # a full disk must not kill the flusher
         with self._lock:
             due = self._service_dumps_locked()
             snapshots = list(self._recent)
@@ -842,19 +854,21 @@ class MetricsRegistry:
             self._flusher = None
         if os.environ.get(FORCE_DUMP_ENV):
             self.request_dump(TRIGGER_FORCED, {"env": FORCE_DUMP_ENV})
+        # the final tick appends the footing snapshot AND refreshes
+        # the exposition file (tick writes it every interval now)
         self.tick()
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
-        if self.job_dir is not None:
-            self._write_exposition(
-                os.path.join(self.job_dir, "metrics.prom"))
 
-    def _write_exposition(self, path: str) -> None:
-        """Prometheus text exposition of the final state — the
+    def render_exposition(self) -> str:
+        """Prometheus text exposition of the CURRENT state — the
         pull-based face the future cross-host ingest tier scrapes
         (ROADMAP item 2); one fixed naming rule: ``rnb_`` prefix,
-        dots -> underscores."""
+        dots -> underscores. One renderer backs both faces: the
+        per-tick/teardown ``metrics.prom`` file and the operator
+        server's live ``GET /metrics`` (rnb_tpu.statusz), so the two
+        can never drift."""
         def prom(metric_name: str) -> str:
             return "rnb_" + re.sub(r"[^a-zA-Z0-9_]", "_", metric_name)
 
@@ -865,28 +879,38 @@ class MetricsRegistry:
             gauges = dict(self._gauges)
             hists = {metric_name: (list(h.buckets), h.count, h.sum_ms)
                      for metric_name, h in self._hists.items()}
-        with open(path, "w") as f:
-            for metric_name in sorted(counters):
-                pn = prom(metric_name)
-                f.write("# TYPE %s counter\n" % pn)
-                f.write("%s %d\n" % (pn, counters[metric_name]))
-            for metric_name in sorted(gauges):
-                pn = prom(metric_name)
-                f.write("# TYPE %s gauge\n" % pn)
-                f.write("%s %g\n" % (pn, gauges[metric_name]))
-            for metric_name in sorted(hists):
-                buckets, count, sum_ms = hists[metric_name]
-                pn = prom(metric_name) + "_ms"
-                f.write("# TYPE %s histogram\n" % pn)
-                cumulative = 0
-                for bound, n in zip(bounds, buckets):
-                    cumulative += n
-                    le = ("+Inf" if math.isinf(bound)
-                          else "%g" % bound)
-                    f.write('%s_bucket{le="%s"} %d\n'
-                            % (pn, le, cumulative))
-                f.write("%s_sum %g\n" % (pn, sum_ms))
-                f.write("%s_count %d\n" % (pn, count))
+        parts: List[str] = []
+        for metric_name in sorted(counters):
+            pn = prom(metric_name)
+            parts.append("# TYPE %s counter\n" % pn)
+            parts.append("%s %d\n" % (pn, counters[metric_name]))
+        for metric_name in sorted(gauges):
+            pn = prom(metric_name)
+            parts.append("# TYPE %s gauge\n" % pn)
+            parts.append("%s %g\n" % (pn, gauges[metric_name]))
+        for metric_name in sorted(hists):
+            buckets, count, sum_ms = hists[metric_name]
+            pn = prom(metric_name) + "_ms"
+            parts.append("# TYPE %s histogram\n" % pn)
+            cumulative = 0
+            for bound, n in zip(bounds, buckets):
+                cumulative += n
+                le = ("+Inf" if math.isinf(bound)
+                      else "%g" % bound)
+                parts.append('%s_bucket{le="%s"} %d\n'
+                             % (pn, le, cumulative))
+            parts.append("%s_sum %g\n" % (pn, sum_ms))
+            parts.append("%s_count %d\n" % (pn, count))
+        return "".join(parts)
+
+    def _write_exposition(self, path: str) -> None:
+        """Write :meth:`render_exposition` atomically (tmp +
+        ``os.replace``) so file-based scrapers watching the per-tick
+        refresh never observe a torn exposition."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.render_exposition())
+        os.replace(tmp, path)
 
     # -- reporting ----------------------------------------------------
 

@@ -288,6 +288,19 @@ META_LINE_REGISTRY = (
               "(whatif-enabled runs only; --check recomputes the "
               "prediction from metrics.jsonl + the config copy alone "
               "and holds it to +-1 milli-vps)"),
+    StampSpec("Operator:", "rnb_tpu/benchmark.py",
+              "operator-plane request ledger (rnb_tpu.statusz): GET "
+              "scrapes served, POST actions accepted, POST actions "
+              "denied by the allow_actions gate, request errors "
+              "(operator-enabled runs only; --check holds the line "
+              "to the logs/<job>/operator.json artifact both ways)"),
+    StampSpec("Stacks:", "rnb_tpu/benchmark.py",
+              "wall-clock stack sampler counters "
+              "(rnb_tpu.stacksampler): sampling ticks, distinct "
+              "thread roles, distinct folded stacks, total per-"
+              "thread samples (operator runs with sample_hz > 0 "
+              "only; --check re-sums stacks.folded to total and "
+              "holds ticks to sample_hz x wall within tolerance)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""``make operator``: the live operator plane, asserted end-to-end.
+
+Two arms, both through ``run_benchmark`` on the 8-virtual-device CPU
+backend (no dataset, no native decoder):
+
+* **Live arm** — a tiny 2-stage pipeline with the root ``operator``
+  key (ephemeral port, actions allowed, 50 Hz stack sampler) and the
+  ``metrics`` plane on. The demo launches the run on a sibling thread,
+  discovers the bound address from ``logs/<job>/operator.json``, and
+  exercises the server WHILE THE RUN SERVES: ``/healthz``,
+  ``/statusz`` and ``/metrics`` must answer 200, and a POSTed
+  ``/flight`` must leave a flight dump loadable per
+  ``rnb_tpu.trace.validate_trace``. The mid-run ``/metrics`` scrape
+  must cross-foot the teardown exposition on every shared series
+  (every live counter survives to ``metrics.prom`` and never
+  shrinks — the live plane and the file artifact are one renderer).
+  The stack sampler must leave ``stacks.folded`` whose counts re-sum
+  to the ``Stacks:`` total, and ``parse_utils --check`` must be green
+  including the new operator invariants.
+* **Off arm** — the same pipeline without the ``operator`` key:
+  no ``operator.json`` / ``stacks.folded`` artifacts, no
+  ``Operator:``/``Stacks:`` lines, and the per-instance timing-table
+  stamp header byte-identical to the pre-operator schema.
+
+Exit 0 = the operator plane observes and steers a live run without
+perturbing the artifacts of runs that never asked for it.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LIVE_CONFIG = {
+    "_comment": "make-operator demo: tiny 2-stage pipeline, operator "
+                "server + stack sampler + live metrics on",
+    "video_path_iterator":
+        "tests.pipeline_helpers.CountingPathIterator",
+    "metrics": {"enabled": True, "interval_ms": 20},
+    "operator": {"port": 0, "allow_actions": True, "sample_hz": 50},
+    "pipeline": [
+        {"model": "tests.pipeline_helpers.TinyLoader",
+         "queue_groups": [{"devices": [0], "out_queues": [0]}],
+         "num_shared_tensors": 4},
+        {"model": "tests.pipeline_helpers.TinySink",
+         "queue_groups": [{"devices": [1], "in_queue": 0}]},
+    ],
+}
+
+#: pre-operator stamp header the off arm must reproduce byte-for-byte
+EXPECTED_HEADER = ["enqueue_filename", "runner0_start",
+                   "inference0_start", "inference0_finish",
+                   "runner1_start", "inference1_start",
+                   "inference1_finish", "device0", "device1"]
+
+
+def _prom_counters(text):
+    """{series: value} for every counter family of one exposition."""
+    kinds = {}
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            kinds[name] = kind
+        elif line and not line.startswith("#"):
+            name, _, value = line.partition(" ")
+            if kinds.get(name) == "counter":
+                out[name] = int(float(value))
+    return out
+
+
+def _discover_operator(log_base, deadline_s=60.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for root, _dirs, files in os.walk(log_base):
+            if "operator.json" in files:
+                with open(os.path.join(root, "operator.json")) as f:
+                    return json.load(f)
+        time.sleep(0.02)
+    return None
+
+
+def _check(parse_utils, log_dir, failures, arm):
+    problems, parse_failed = parse_utils.check_job_detail(log_dir)
+    for problem in problems:
+        failures.append("%s --check (%s): %s"
+                        % (arm, "parse" if parse_failed
+                           else "invariant", problem))
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rnb_tpu.benchmark import run_benchmark
+    from rnb_tpu.trace import validate_trace
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    failures = []
+
+    with tempfile.TemporaryDirectory(prefix="rnb-operator-") as tmp:
+        cfg_path = os.path.join(tmp, "operator-demo.json")
+        with open(cfg_path, "w") as f:
+            json.dump(LIVE_CONFIG, f)
+        log_base = os.path.join(tmp, "logs")
+        holder = {}
+
+        def run():
+            holder["res"] = run_benchmark(
+                cfg_path, mean_interval_ms=15, num_videos=150,
+                queue_size=50, log_base=log_base,
+                print_progress=False)
+
+        runner = threading.Thread(target=run)
+        runner.start()
+        addr = _discover_operator(log_base)
+        # a few flusher intervals of serving before the scrape, so the
+        # live exposition already carries bridged/polled series (the
+        # run lasts ~2.5 s; this stays well inside it)
+        time.sleep(0.6)
+        live_scrape = None
+        if addr is None:
+            failures.append("operator.json never appeared — the "
+                            "server did not bind")
+        else:
+            def get(path):
+                with urllib.request.urlopen(addr["url"] + path,
+                                            timeout=10) as r:
+                    return r.status, r.read().decode()
+
+            code, health = get("/healthz")
+            payload = json.loads(health)
+            print("live /healthz: %s (flag %s)"
+                  % (payload.get("status"),
+                     payload.get("termination_flag")))
+            if code != 200:
+                failures.append("/healthz answered %d" % code)
+            code, live_scrape = get("/metrics")
+            if code != 200:
+                failures.append("/metrics answered %d" % code)
+                live_scrape = None
+            code, statusz = get("/statusz")
+            if code != 200 or "TinyLoader" not in statusz:
+                failures.append("/statusz missing or topology-less "
+                                "(code %d)" % code)
+            code, stacks = get("/stacks")
+            if code != 200 or "client" not in stacks:
+                failures.append("/stacks did not show the pipeline "
+                                "threads (code %d)" % code)
+            req = urllib.request.Request(addr["url"] + "/flight",
+                                         data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                if r.status != 200:
+                    failures.append("POST /flight answered %d"
+                                    % r.status)
+        runner.join(timeout=300)
+        if runner.is_alive():
+            failures.append("the live arm never finished")
+            for failure in failures:
+                print("FAIL: %s" % failure)
+            return 1
+        res = holder["res"]
+        if res.termination_flag != 0:
+            failures.append("live arm terminated with flag %d"
+                            % res.termination_flag)
+        print("live arm: %d scrape(s), %d action(s), %d denied, "
+              "%d error(s); sampler %d tick(s) -> %d folded stack(s) "
+              "(%d samples)"
+              % (res.operator_scrapes, res.operator_actions,
+                 res.operator_denied, res.operator_errors,
+                 res.stacks_samples, res.stacks_folded,
+                 res.stacks_total))
+        if res.operator_scrapes < 3:
+            failures.append("only %d scrape(s) counted (the demo made "
+                            "at least 4 GETs)" % res.operator_scrapes)
+        if res.operator_actions < 1:
+            failures.append("the POSTed /flight was not counted as an "
+                            "action")
+
+        # live scrape cross-foots the teardown exposition: every live
+        # counter series survives and never shrinks
+        if live_scrape is not None:
+            final_path = os.path.join(res.log_dir, "metrics.prom")
+            final = _prom_counters(open(final_path).read())
+            live = _prom_counters(live_scrape)
+            if not live:
+                failures.append("live /metrics scrape carried no "
+                                "counter series")
+            shared = 0
+            for name, value in live.items():
+                if name not in final:
+                    failures.append("live series %s vanished from the "
+                                    "teardown exposition" % name)
+                elif value > final[name]:
+                    failures.append(
+                        "live %s=%d exceeds the teardown value %d "
+                        "(counters are monotone)"
+                        % (name, value, final[name]))
+                else:
+                    shared += 1
+            print("live scrape: %d counter series cross-foot the "
+                  "teardown exposition" % shared)
+
+        # the POSTed /flight left a valid dump
+        dumps = sorted(name for name in os.listdir(res.log_dir)
+                       if name.startswith("flight-")
+                       and name.endswith(".json"))
+        if not dumps:
+            failures.append("POST /flight left no flight dump")
+        for name in dumps:
+            path = os.path.join(res.log_dir, name)
+            for issue in validate_trace(path):
+                failures.append("%s: %s" % (name, issue))
+            doc = json.load(open(path))
+            if doc["otherData"].get("flight_trigger") != "forced":
+                failures.append("%s: trigger %r, expected 'forced'"
+                                % (name, doc["otherData"]
+                                   .get("flight_trigger")))
+
+        # the sampler's folded artifact re-sums to the Stacks: total
+        folded_path = os.path.join(res.log_dir, "stacks.folded")
+        if not os.path.isfile(folded_path):
+            failures.append("no stacks.folded artifact")
+        else:
+            total = 0
+            for line in open(folded_path):
+                if line.strip():
+                    total += int(line.rsplit(" ", 1)[1])
+            if total != res.stacks_total:
+                failures.append("stacks.folded sums to %d but the run "
+                                "counted %d samples"
+                                % (total, res.stacks_total))
+        _check(parse_utils, res.log_dir, failures, "live arm")
+
+        # -- off arm --------------------------------------------------
+        off_raw = dict(LIVE_CONFIG)
+        del off_raw["operator"]
+        del off_raw["metrics"]
+        off_path = os.path.join(tmp, "operator-off.json")
+        with open(off_path, "w") as f:
+            json.dump(off_raw, f)
+        res_off = run_benchmark(off_path, mean_interval_ms=1,
+                                num_videos=40, queue_size=50,
+                                log_base=os.path.join(tmp, "off-logs"),
+                                print_progress=False)
+        if res_off.termination_flag != 0:
+            failures.append("off arm terminated with flag %d"
+                            % res_off.termination_flag)
+        for artifact in ("operator.json", "stacks.folded"):
+            if os.path.isfile(os.path.join(res_off.log_dir, artifact)):
+                failures.append("operator-off arm wrote %s" % artifact)
+        meta_text = open(os.path.join(res_off.log_dir,
+                                      "log-meta.txt")).read()
+        for prefix in ("Operator:", "Stacks:"):
+            if prefix in meta_text:
+                failures.append("operator-off arm wrote a %r meta "
+                                "line" % prefix)
+        tables = [n for n in os.listdir(res_off.log_dir)
+                  if "group" in n]
+        header = open(os.path.join(res_off.log_dir,
+                                   tables[0])).read().split("\n",
+                                                            1)[0]
+        if header.split() != EXPECTED_HEADER:
+            failures.append("operator-off stamp header drifted: %s"
+                            % header)
+        _check(parse_utils, res_off.log_dir, failures, "off arm")
+        print("off arm: byte-stable (no operator artifacts, "
+              "pre-operator stamp header)")
+
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("OK — the operator plane serves /healthz, /statusz and a "
+          "live /metrics scrape that cross-foots the teardown "
+          "exposition, a POSTed /flight dump validates, the stack "
+          "sampler's folded counts re-sum, and operator-off logs "
+          "stay byte-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,6 +196,23 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["whatif_" + key] = int(val)
+        elif line.startswith("Operator:"):
+            # "Operator: scrapes=S actions=A denied=D errors=E" — the
+            # operator-plane HTTP server's request ledger
+            # (rnb_tpu.statusz), operator-enabled runs only; --check
+            # holds the line to the operator.json artifact both ways
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["operator_" + key] = int(val)
+        elif line.startswith("Stacks:"):
+            # "Stacks: samples=S threads=T folded=F total=N" — the
+            # wall-clock stack sampler ledger (rnb_tpu.stacksampler),
+            # operator runs with sample_hz > 0 only; --check re-sums
+            # the stacks.folded artifact to total and holds samples
+            # to sample_hz x wall within tolerance
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["stacks_" + key] = int(val)
         elif line.startswith("Phases:"):
             # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
             # latency attribution over steady-state completions,
@@ -1011,6 +1028,11 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # config copy alone
     problems.extend(_check_critpath(job_dir, meta, tables))
     problems.extend(_check_whatif(job_dir, meta))
+    # operator plane (rnb_tpu.statusz / rnb_tpu.stacksampler): the
+    # Operator: ledger and the operator.json artifact must agree both
+    # ways, the stacks.folded counts must re-sum to the Stacks: total,
+    # and the sampler's tick count must track sample_hz x wall
+    problems.extend(_check_operator(job_dir, meta))
     return problems, parse_failed
 
 
@@ -2075,6 +2097,177 @@ def _check_whatif(job_dir: str, meta: Dict[str, object]) -> List[str]:
             "config copy recompute %d"
             % (meta.get("whatif_pred_vps_milli"),
                recomputed["pred_vps_milli"]))
+    return problems
+
+
+#: sampler-cadence tolerance: the tick count of a wait()-paced loop
+#: can never exceed sample_hz x elapsed by much (slack for the short
+#: post-window drain to thread join), and on a loaded 1-core host the
+#: GIL can stretch individual waits — the lower bound is deliberately
+#: loose
+_STACKS_UPPER_SLACK = 1.5
+_STACKS_LOWER_FRAC = 0.2
+_STACKS_ABS_SLACK = 25
+
+
+def _config_operator(job_dir: str):
+    """The job's declared ``operator`` spec from the config copy
+    benchmark.py drops into the job dir, or None when no config copy
+    declares an enabled one."""
+    import json
+    for name in sorted(os.listdir(job_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(job_dir, name)) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(raw, dict) or "pipeline" not in raw:
+            continue
+        operator = raw.get("operator")
+        if isinstance(operator, dict) \
+                and operator.get("enabled", True):
+            return operator
+        return None
+    return None
+
+
+def _check_operator(job_dir: str,
+                    meta: Dict[str, object]) -> List[str]:
+    """Operator-plane invariants (rnb_tpu.statusz /
+    rnb_tpu.stacksampler): the request ledger agrees with the
+    operator.json artifact both ways, the folded-stack artifact
+    re-sums to the Stacks: total, and the sampler cadence tracks
+    sample_hz x wall."""
+    import json
+    problems: List[str] = []
+    op_path = os.path.join(job_dir, "operator.json")
+    folded_path = os.path.join(job_dir, "stacks.folded")
+    if "operator_scrapes" not in meta:
+        if os.path.isfile(op_path):
+            problems.append("operator.json present but log-meta has "
+                            "no 'Operator:' line")
+        if "stacks_samples" in meta:
+            problems.append("log-meta carries a 'Stacks:' line but no "
+                            "'Operator:' line (the sampler rides the "
+                            "operator key)")
+        if os.path.isfile(folded_path):
+            problems.append("stacks.folded present but log-meta has "
+                            "no 'Stacks:' line")
+        return problems
+    for key in ("operator_scrapes", "operator_actions",
+                "operator_denied", "operator_errors"):
+        if int(meta.get(key, 0)) < 0:
+            problems.append("negative %s" % key)
+    if not os.path.isfile(op_path):
+        problems.append("log-meta carries an 'Operator:' line but "
+                        "operator.json is missing — the bound address "
+                        "record must ship with the ledger")
+    else:
+        try:
+            with open(op_path) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append("operator.json unreadable: %s" % e)
+            record = None
+        if record is not None:
+            port = record.get("port")
+            if not isinstance(port, int) or not 1 <= port <= 65535:
+                problems.append("operator.json carries no bound port "
+                                "(got %r) — port 0 must be resolved "
+                                "to the ephemeral port at bind time"
+                                % (port,))
+            if not record.get("host"):
+                problems.append("operator.json names no host")
+    # -- the stack sampler ---------------------------------------------
+    if "stacks_samples" not in meta:
+        if os.path.isfile(folded_path):
+            problems.append("stacks.folded present but log-meta has "
+                            "no 'Stacks:' line")
+        return problems
+    for key in ("stacks_samples", "stacks_threads", "stacks_folded",
+                "stacks_total"):
+        if int(meta.get(key, 0)) < 0:
+            problems.append("negative %s" % key)
+    if not os.path.isfile(folded_path):
+        problems.append("log-meta carries a 'Stacks:' line but "
+                        "stacks.folded is missing")
+    else:
+        total = 0
+        stacks = 0
+        roles = set()
+        bad_lines = 0
+        with open(folded_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                stack, _, count = line.rpartition(" ")
+                if not stack or not count.lstrip("-").isdigit():
+                    bad_lines += 1
+                    continue
+                stacks += 1
+                total += int(count)
+                roles.add(stack.split(";", 1)[0])
+        if bad_lines:
+            problems.append("stacks.folded holds %d unparsable "
+                            "line(s) (want 'role;frame;... count')"
+                            % bad_lines)
+        if stacks != meta.get("stacks_folded"):
+            problems.append(
+                "stacks.folded holds %d folded stack(s) but the "
+                "'Stacks:' line says folded=%s"
+                % (stacks, meta.get("stacks_folded")))
+        if total != meta.get("stacks_total"):
+            problems.append(
+                "stacks.folded counts sum to %d but the 'Stacks:' "
+                "line says total=%s (every sample must fold exactly "
+                "once)" % (total, meta.get("stacks_total")))
+        if len(roles) != meta.get("stacks_threads"):
+            problems.append(
+                "stacks.folded names %d role(s) but the 'Stacks:' "
+                "line says threads=%s"
+                % (len(roles), meta.get("stacks_threads")))
+    # every folded stack was observed at least once (counts >= 1), so
+    # the distinct-stack count can never exceed the sample total.
+    # (total vs samples x threads is deliberately NOT bounded: several
+    # pool workers collapse onto one role — rnb-decode, rnb-transfer —
+    # so one tick may legally contribute many samples to one role.)
+    samples = int(meta.get("stacks_samples", 0))
+    if int(meta.get("stacks_folded", 0)) \
+            > int(meta.get("stacks_total", 0)):
+        problems.append(
+            "stacks_folded=%s exceeds stacks_total=%s (every distinct "
+            "stack was sampled at least once)"
+            % (meta.get("stacks_folded"), meta.get("stacks_total")))
+    # cadence: samples ~ sample_hz x measured wall within tolerance
+    operator = _config_operator(job_dir)
+    wall = meta.get("wall_time_s")
+    if operator is not None and isinstance(wall, float) and wall > 0:
+        hz = operator.get("sample_hz")
+        if hz is None:
+            _rnb_trace()  # ensure the repo checkout is importable
+            from rnb_tpu.stacksampler import DEFAULT_SAMPLE_HZ
+            hz = DEFAULT_SAMPLE_HZ
+        hz = float(hz)
+        if hz > 0:
+            expected = hz * wall
+            upper = expected * _STACKS_UPPER_SLACK + _STACKS_ABS_SLACK
+            lower = max(0.0, expected * _STACKS_LOWER_FRAC
+                        - _STACKS_ABS_SLACK)
+            if samples > upper:
+                problems.append(
+                    "stacks_samples=%d far exceeds sample_hz x wall "
+                    "= %.1f (upper tolerance %.1f) — the sampler "
+                    "cannot tick faster than its wait loop"
+                    % (samples, expected, upper))
+            if samples < lower:
+                problems.append(
+                    "stacks_samples=%d falls far below sample_hz x "
+                    "wall = %.1f (lower tolerance %.1f) — the "
+                    "sampler stalled or died mid-run"
+                    % (samples, expected, lower))
     return problems
 
 

@@ -1,0 +1,657 @@
+"""The operator plane (rnb_tpu.statusz) + wall-clock stack sampler
+(rnb_tpu.stacksampler): server lifecycle, endpoint schemas,
+allow_actions gating, folded-stack math, live-scrape footing, and the
+operator-off byte-stability contract.
+
+Unit coverage drives the server directly over fabricated registries
+(no JAX); the e2e cases run the tiny test pipeline
+(tests.pipeline_helpers) through run_benchmark with the root
+``operator`` config key on and off, scraping the live endpoints from a
+sibling thread mid-run.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rnb_tpu import metrics, trace
+from rnb_tpu.metrics import MetricsRegistry, MetricsSettings, SpanBridge
+from rnb_tpu.stacksampler import (DEFAULT_SAMPLE_HZ, StackSampler,
+                                  role_of, walk_stack)
+from rnb_tpu.statusz import (OperatorServer, OperatorSettings,
+                             parse_whatif_query)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_active_registry():
+    metrics.ACTIVE = None
+    trace.ACTIVE = None
+    yield
+    metrics.ACTIVE = None
+    trace.ACTIVE = None
+
+
+def _get(server, path, timeout=10):
+    url = "http://127.0.0.1:%d%s" % (server.port, path)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(server, path, timeout=10):
+    url = "http://127.0.0.1:%d%s" % (server.port, path)
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- settings / config validation -------------------------------------
+
+def test_settings_from_config():
+    assert OperatorSettings.from_config(None) is None
+    assert OperatorSettings.from_config({"enabled": False}) is None
+    s = OperatorSettings.from_config({})
+    assert s is not None
+    assert s.port == 0 and not s.allow_actions
+    assert s.sample_hz == DEFAULT_SAMPLE_HZ
+    s = OperatorSettings.from_config(
+        {"port": 8123, "allow_actions": True, "sample_hz": 0})
+    assert s.port == 8123 and s.allow_actions and s.sample_hz == 0.0
+
+
+def _cfg(operator_value, extra=None):
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "operator": operator_value,
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 4},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [1], "in_queue": 0}]},
+        ],
+    }
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def test_config_accepts_valid_operator_key():
+    from rnb_tpu.config import parse_config
+    cfg = parse_config(_cfg({"enabled": True, "port": 0,
+                             "allow_actions": True, "sample_hz": 10}))
+    assert cfg.operator == {"enabled": True, "port": 0,
+                            "allow_actions": True, "sample_hz": 10}
+
+
+@pytest.mark.parametrize("bad", [
+    "yes",                       # not an object
+    {"enable": True},            # unknown key
+    {"enabled": 1},              # non-bool enabled
+    {"allow_actions": "no"},     # non-bool gate
+    {"port": -1},                # out of range
+    {"port": 70000},             # out of range
+    {"port": True},              # bool as int
+    {"port": 8.5},               # non-int
+    {"sample_hz": -1},           # negative
+    {"sample_hz": True},         # bool as number
+])
+def test_config_rejects_bad_operator_key(bad):
+    from rnb_tpu.config import ConfigError, parse_config
+    with pytest.raises(ConfigError):
+        parse_config(_cfg(bad))
+
+
+# -- server lifecycle -------------------------------------------------
+
+def test_server_lifecycle_ephemeral_port_and_clean_shutdown(tmp_path):
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path),
+                            job_id="life-test")
+    server.start()
+    try:
+        assert server.port and server.port > 0
+        record = json.load(open(str(tmp_path / "operator.json")))
+        assert record["port"] == server.port
+        assert record["host"] == "127.0.0.1"
+        assert record["job_id"] == "life-test"
+        assert record["allow_actions"] is False
+        assert "/healthz" in record["endpoints"]
+        code, body = _get(server, "/healthz")
+        assert code == 200
+    finally:
+        server.stop()
+    # clean shutdown: the listening socket is closed, so a fresh
+    # server can bind the port (SO_REUSEADDR like HTTPServer itself —
+    # the test's own completed request leaves a TIME_WAIT peer entry)
+    import socket
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        s.bind(("127.0.0.1", server.port))
+    finally:
+        s.close()
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            "http://127.0.0.1:%d/healthz" % server.port, timeout=0.5)
+
+
+# -- endpoint schemas -------------------------------------------------
+
+def test_healthz_schema_and_lane_states(tmp_path):
+    from rnb_tpu.health import HealthSettings, LaneHealthBoard
+    board = LaneHealthBoard((3, 4), HealthSettings())
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path),
+                            job_id="hz", boards={1: board})
+    server.start()
+    try:
+        code, body = _get(server, "/healthz")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["status"] == "ok" and payload["serving"]
+        assert payload["lanes"] == {"3": "healthy", "4": "healthy"}
+        assert payload["boards"] == 1
+        board.evict(4, "test kill")
+        code, body = _get(server, "/healthz")
+        payload = json.loads(body)
+        assert payload["status"] == "degraded"
+        assert payload["lanes"]["4"] == "evicted"
+        assert payload["degraded_lanes"] == ["4"]
+    finally:
+        server.stop()
+
+
+def test_metrics_endpoint_serves_live_exposition(tmp_path):
+    reg = MetricsRegistry(MetricsSettings(), job_dir=None)
+    reg.inc_counter("client.requests", 5)
+    reg.observe_ms("exec0.model_call", 4.0)
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path),
+                            job_id="mx", metrics_registry=reg)
+    server.start()
+    try:
+        code, body = _get(server, "/metrics")
+        assert code == 200
+        assert "rnb_client_requests 5" in body
+        assert 'rnb_exec0_model_call_ms_bucket{le="+Inf"} 1' in body
+        # one renderer backs the endpoint and the file exposition
+        assert body == reg.render_exposition()
+        # live: a counter bump is visible on the next scrape
+        reg.inc_counter("client.requests", 2)
+        code, body = _get(server, "/metrics")
+        assert "rnb_client_requests 7" in body
+    finally:
+        server.stop()
+    assert server.summary()["scrapes"] == 2
+
+
+def test_metrics_endpoint_503_without_registry(tmp_path):
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path))
+    server.start()
+    try:
+        code, body = _get(server, "/metrics")
+        assert code == 503 and "metrics plane disabled" in body
+    finally:
+        server.stop()
+    summary = server.summary()
+    assert summary["errors"] == 1 and summary["scrapes"] == 0
+
+
+def test_statusz_html_sections(tmp_path):
+    topology = {"steps": [
+        {"step": 0, "model": "tests.pipeline_helpers.TinyLoader",
+         "groups": 1, "instances": 1, "replica_lanes": []},
+        {"step": 1, "model": "tests.pipeline_helpers.TinySink",
+         "groups": 1, "instances": 2, "replica_lanes": [3, 4]}]}
+    probes = [("queue.e0.depth", lambda: 7, 50)]
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path),
+                            job_id="sz", topology=topology,
+                            queue_probes=probes)
+    server.start()
+    try:
+        code, body = _get(server, "/statusz")
+        assert code == 200
+        assert "TinyLoader" in body and "TinySink" in body
+        assert "queue.e0.depth" in body and ">7<" in body
+        for section in ("Pipeline topology", "Queue depths",
+                        "Replica lanes", "SLO", "Memory owners",
+                        "Compute", "Stack sampler"):
+            assert section in body
+    finally:
+        server.stop()
+
+
+def test_stacks_endpoint_dumps_all_threads(tmp_path):
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path))
+    server.start()
+    try:
+        code, body = _get(server, "/stacks")
+        assert code == 200
+        assert "MainThread" in body
+        assert "operator-server" in body
+    finally:
+        server.stop()
+
+
+def test_unknown_route_404_counts_error(tmp_path):
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path))
+    server.start()
+    try:
+        code, body = _get(server, "/nope")
+        assert code == 404
+        assert "/healthz" in json.loads(body)["endpoints"]
+    finally:
+        server.stop()
+    assert server.summary()["errors"] == 1
+
+
+# -- /whatif ----------------------------------------------------------
+
+def test_parse_whatif_query():
+    spec = parse_whatif_query(
+        "replicas_step1=4&service_scale_step0=0.5&arrival_scale=2"
+        "&pool_rows=30")
+    assert spec == {"replicas": {"step1": 4},
+                    "service_scale": {"step0": 0.5},
+                    "arrival_scale": 2.0, "pool_rows": 30}
+    assert parse_whatif_query("replicas_step2=%2B1") \
+        == {"replicas": {"step2": "+1"}}
+    with pytest.raises(ValueError):
+        parse_whatif_query("bogus=1")
+    # an unencoded '+1' decodes to ' 1' — reading it as the absolute
+    # count 1 would silently answer a scale-DOWN counterfactual, so
+    # whitespace fails loudly with the %2B hint instead
+    with pytest.raises(ValueError, match="%2B"):
+        parse_whatif_query("replicas_step2=+1")
+
+
+def _calibratable_registry():
+    reg = MetricsRegistry(MetricsSettings(), job_dir=None)
+    for _ in range(20):
+        reg.observe_ms("exec0.model_call", 4.0)
+        reg.observe_ms("exec1.model_call", 8.0)
+    reg.slo_tracked = 20
+    reg.snapshot(now=time.time())
+    return reg
+
+
+def test_whatif_endpoint_answers_live(tmp_path):
+    reg = _calibratable_registry()
+    raw = {"pipeline": [{"queue_groups": [{"devices": [0]}]},
+                        {"queue_groups": [{"devices": [1]}]}]}
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path),
+                            job_id="wi", metrics_registry=reg,
+                            config_raw=raw,
+                            window={"t0": time.time() - 2.0})
+    server.start()
+    try:
+        code, body = _get(server, "/whatif?service_scale_step1=0.5")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["calibrated"] is True
+        assert payload["stages"] == 2
+        assert payload["base_vps"] > 0
+        assert payload["pred_vps"] > payload["base_vps"]
+        code, body = _get(server, "/whatif?bogus=1")
+        assert code == 400
+    finally:
+        server.stop()
+
+
+def test_whatif_endpoint_503_without_metrics(tmp_path):
+    server = OperatorServer(OperatorSettings(), job_dir=str(tmp_path))
+    server.start()
+    try:
+        code, body = _get(server, "/whatif")
+        assert code == 503
+        assert "metrics" in json.loads(body)["error"]
+    finally:
+        server.stop()
+
+
+# -- POST actions / allow_actions gating ------------------------------
+
+def test_actions_denied_without_allow_actions(tmp_path):
+    reg = MetricsRegistry(MetricsSettings(), job_dir=str(tmp_path))
+    reg.bridge = SpanBridge(reg, ring_events=64)
+    server = OperatorServer(OperatorSettings(allow_actions=False),
+                            job_dir=str(tmp_path),
+                            metrics_registry=reg)
+    server.start()
+    try:
+        for route in ("/flight", "/capture"):
+            code, body = _post(server, route)
+            assert code == 403
+            assert "allow_actions" in json.loads(body)["error"]
+    finally:
+        server.stop()
+    summary = server.summary()
+    assert summary["denied"] == 2 and summary["actions"] == 0
+
+
+def test_flight_action_forces_a_valid_dump(tmp_path):
+    from rnb_tpu.trace import validate_trace
+    reg = MetricsRegistry(MetricsSettings(), job_dir=str(tmp_path),
+                          job_id="fl")
+    reg.bridge = SpanBridge(reg, ring_events=64)
+    trace.ACTIVE = reg.bridge
+    with trace.span("exec0.model_call", rid=1):
+        pass
+    server = OperatorServer(OperatorSettings(allow_actions=True),
+                            job_dir=str(tmp_path),
+                            metrics_registry=reg)
+    server.start()
+    try:
+        code, body = _post(server, "/flight")
+        assert code == 200
+        assert json.loads(body)["armed"] == "flight"
+    finally:
+        server.stop()
+    reg.tick()  # the flusher services the armed dump
+    dump = str(tmp_path / "flight-0.json")
+    assert os.path.isfile(dump)
+    assert validate_trace(dump) == []
+    doc = json.load(open(dump))
+    assert doc["otherData"]["flight_trigger"] == "forced"
+    assert server.summary()["actions"] == 1
+
+
+def test_flight_action_503_without_recorder(tmp_path):
+    server = OperatorServer(OperatorSettings(allow_actions=True),
+                            job_dir=str(tmp_path))
+    server.start()
+    try:
+        code, body = _post(server, "/flight")
+        assert code == 503
+    finally:
+        server.stop()
+    assert server.summary()["errors"] == 1
+
+
+def test_capture_action_arms_devobs(tmp_path):
+    class FakePlane:
+        def __init__(self):
+            self.requests = []
+
+        def request_capture(self, trigger):
+            self.requests.append(trigger)
+
+    plane = FakePlane()
+    server = OperatorServer(OperatorSettings(allow_actions=True),
+                            job_dir=str(tmp_path), devobs_plane=plane)
+    server.start()
+    try:
+        code, body = _post(server, "/capture")
+        assert code == 200
+        assert plane.requests == ["operator"]
+        # no devobs plane -> 503
+        server.devobs_plane = None
+        code, _ = _post(server, "/capture")
+        assert code == 503
+    finally:
+        server.stop()
+
+
+# -- stack sampler ----------------------------------------------------
+
+def test_role_filter():
+    assert role_of("client") == "client"
+    assert role_of("runner-s0-g0-i1") == "runner-s0-g0-i1"
+    assert role_of("rnb-decode_3") == "rnb-decode"
+    assert role_of("rnb-transfer") == "rnb-transfer"
+    assert role_of("MainThread") is None
+    assert role_of("metrics-flusher") is None
+    assert role_of("stack-sampler") is None
+
+
+def test_sampler_folded_math_on_synthetic_stacks(tmp_path):
+    sampler = StackSampler(sample_hz=10.0)
+    # 3 ticks: client always in the same stack; runner alternates
+    for tick in range(3):
+        with sampler._lock:
+            sampler.samples += 1
+        sampler.record("client", ("run", "poisson", "sleep"),
+                       now=100.0 + tick)
+        sampler.record("runner-s0-g0-i0",
+                       ("run", "loop", "get" if tick % 2 else "call"),
+                       now=100.0 + tick)
+    summary = sampler.summary()
+    assert summary == {"samples": 3, "threads": 2, "folded": 3,
+                       "total": 6}
+    lines = sampler.folded_lines()
+    assert "client;run;poisson;sleep 3" in lines
+    assert "runner-s0-g0-i0;run;loop;get 1" in lines
+    assert "runner-s0-g0-i0;run;loop;call 2" in lines
+    # the artifact re-sums to the summary total (the --check rule)
+    path = str(tmp_path / "stacks.folded")
+    sampler.write_folded(path)
+    total = 0
+    for line in open(path):
+        stack, _, count = line.strip().rpartition(" ")
+        assert stack and count.isdigit()
+        total += int(count)
+    assert total == summary["total"]
+    # timeline tiles: one per sample, on stacks:<role> tracks, leaf-named
+    events = sampler.trace_events()
+    assert len(events) == 6
+    names = {e[4] for e in events}
+    assert names == {"stacks:client", "stacks:runner-s0-g0-i0"}
+    assert all(e[1] == "X" and e[3] == 0.1 for e in events)
+    leaves = [e[0] for e in events if e[4] == "stacks:client"]
+    assert leaves == ["sleep"] * 3
+
+
+def test_sampler_samples_live_pipeline_threads():
+    stop = threading.Event()
+
+    def park():
+        stop.wait(10.0)
+
+    t = threading.Thread(target=park, name="runner-s9-g0-i0",
+                         daemon=True)
+    t.start()
+    try:
+        sampler = StackSampler(sample_hz=100.0)
+        sampled = sampler.sample_once()
+        assert sampled >= 1
+        summary = sampler.summary()
+        assert summary["samples"] == 1
+        assert any(key[0] == "runner-s9-g0-i0"
+                   for key in sampler._folded)
+        # the folded stack walks root-first down to the wait leaf
+        (key,) = [k for k in sampler._folded
+                  if k[0] == "runner-s9-g0-i0"]
+        assert any("park" in frame for frame in key)
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_sampler_lifecycle_runs_and_stops():
+    sampler = StackSampler(sample_hz=200.0)
+    sampler.start()
+    deadline = time.monotonic() + 5.0
+    while sampler.samples < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sampler.stop()
+    assert sampler.samples >= 3
+    ticks = sampler.samples
+    time.sleep(0.05)
+    assert sampler.samples == ticks  # really stopped
+    # hz = 0 never starts a thread
+    off = StackSampler(sample_hz=0.0)
+    off.start()
+    assert off._thread is None
+
+
+# -- e2e --------------------------------------------------------------
+
+def _run(tmp_path, run_name, operator_value, extra=None, videos=40,
+         interval_ms=1):
+    from rnb_tpu.benchmark import run_benchmark
+    cfg = _cfg(operator_value, extra)
+    if operator_value is None:
+        del cfg["operator"]
+    path = os.path.join(str(tmp_path), "%s.json" % run_name)
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return run_benchmark(path, mean_interval_ms=interval_ms,
+                         num_videos=videos, queue_size=50,
+                         log_base=os.path.join(str(tmp_path),
+                                               "logs-%s" % run_name),
+                         print_progress=False)
+
+
+def _parse_utils():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+    return parse_utils
+
+
+def _prom_counters(text):
+    """{series: value} for every counter family of one exposition."""
+    kinds = {}
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            kinds[name] = kind
+        elif line and not line.startswith("#"):
+            name, _, value = line.partition(" ")
+            if kinds.get(name) == "counter":
+                out[name] = int(float(value))
+    return out
+
+
+def test_operator_run_end_to_end_with_live_scrape(tmp_path):
+    holder = {}
+
+    def run():
+        holder["res"] = _run(tmp_path, "live",
+                             {"port": 0, "allow_actions": True,
+                              "sample_hz": 50},
+                             extra={"metrics": {"enabled": True,
+                                                "interval_ms": 20},
+                                    "trace": {"enabled": True,
+                                              "sample_hz": 50}},
+                             videos=150, interval_ms=15)
+
+    t = threading.Thread(target=run)
+    t.start()
+    log_base = os.path.join(str(tmp_path), "logs-live")
+    addr = None
+    deadline = time.monotonic() + 60.0
+    while addr is None and time.monotonic() < deadline:
+        for root, _dirs, files in os.walk(log_base):
+            if "operator.json" in files:
+                addr = json.load(open(os.path.join(root,
+                                                   "operator.json")))
+        time.sleep(0.02)
+    assert addr is not None, "operator.json never appeared"
+
+    def get(path):
+        with urllib.request.urlopen(addr["url"] + path,
+                                    timeout=10) as r:
+            return r.status, r.read().decode()
+
+    code, health = get("/healthz")
+    assert code == 200
+    assert json.loads(health)["status"] in ("ok", "draining")
+    code, live_scrape = get("/metrics")
+    assert code == 200
+    code, statusz = get("/statusz")
+    assert code == 200 and "TinyLoader" in statusz
+    req = urllib.request.Request(addr["url"] + "/flight", data=b"",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    t.join(timeout=120)
+    assert not t.is_alive()
+    res = holder["res"]
+    assert res.termination_flag == 0
+    assert res.operator_scrapes >= 3
+    assert res.operator_actions >= 1
+    assert res.operator_denied == 0
+
+    # live-scrape counters cross-foot the final snapshot: every live
+    # counter series survives to the teardown exposition and never
+    # shrinks (counters are monotone)
+    final = _prom_counters(
+        open(os.path.join(res.log_dir, "metrics.prom")).read())
+    live = _prom_counters(live_scrape)
+    assert live, "live scrape carried no counter series"
+    for name, value in live.items():
+        assert name in final, "series %s vanished at teardown" % name
+        assert value <= final[name], (name, value, final[name])
+
+    # the forced dump (POST /flight) is on disk and the sampler left
+    # its artifacts
+    assert res.metrics_dumps >= 1
+    assert res.stacks_samples > 0
+    assert res.stacks_total > 0
+    assert os.path.isfile(os.path.join(res.log_dir, "stacks.folded"))
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Operator: scrapes=%d" % res.operator_scrapes in meta_text
+    assert "Stacks: samples=%d" % res.stacks_samples in meta_text
+
+    # sampler tracks merged into the trace
+    from rnb_tpu.trace import track_names
+    tracks = track_names(os.path.join(res.log_dir, "trace.json"))
+    assert any(name.startswith("stacks:") for name in tracks)
+
+    parse_utils = _parse_utils()
+    try:
+        assert parse_utils.check_job(res.log_dir) == []
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+
+def test_check_catches_cooked_folded_stacks(tmp_path):
+    res = _run(tmp_path, "cooked", {"sample_hz": 100}, videos=30,
+               interval_ms=5)
+    assert res.termination_flag == 0
+    folded = os.path.join(res.log_dir, "stacks.folded")
+    lines = open(folded).read().splitlines()
+    stack, _, count = lines[0].rpartition(" ")
+    lines[0] = "%s %d" % (stack, int(count) + 5)  # cook the books
+    with open(folded, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    parse_utils = _parse_utils()
+    try:
+        problems = parse_utils.check_job(res.log_dir)
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+    assert any("sum to" in p for p in problems)
+
+
+def test_operator_off_run_stays_byte_stable(tmp_path):
+    res = _run(tmp_path, "plain", None)
+    assert res.termination_flag == 0
+    assert res.operator_scrapes == 0 and res.stacks_samples == 0
+    for artifact in ("operator.json", "stacks.folded"):
+        assert not os.path.isfile(os.path.join(res.log_dir, artifact))
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Operator:" not in meta_text and "Stacks:" not in meta_text
+    tables = [n for n in os.listdir(res.log_dir) if "group" in n]
+    with open(os.path.join(res.log_dir, tables[0])) as f:
+        report = f.read()
+    # the stamp schema is exactly the pre-operator set
+    header = report.split("\n", 1)[0].split()
+    assert header == ["enqueue_filename", "runner0_start",
+                      "inference0_start", "inference0_finish",
+                      "runner1_start", "inference1_start",
+                      "inference1_finish", "device0", "device1"]

@@ -404,6 +404,8 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Critpath: requests=%d\\n" % cr)\n'
                      'f.write("Critpath stages: %s\\n" % ct)\n'
                      'f.write("Whatif: stages=%d\\n" % wi)\n'
+                     'f.write("Operator: scrapes=%d\\n" % op)\n'
+                     'f.write("Stacks: samples=%d\\n" % st)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -463,7 +465,11 @@ REPO_BENCH_LIKE = (
         'residual_us_max=%d hedged=%d redispatched=%d bound_step=%d '
         'bound_vps_milli=%d\\n" % cr)\n'
         'f.write("Whatif: stages=%d calibrated=%d pred_vps_milli=%d '
-        'bottleneck_step=%d\\n" % wi)\n')
+        'bottleneck_step=%d\\n" % wi)\n'
+        'f.write("Operator: scrapes=%d actions=%d denied=%d '
+        'errors=%d\\n" % op)\n'
+        'f.write("Stacks: samples=%d threads=%d folded=%d '
+        'total=%d\\n" % st)\n')
 
 
 def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
@@ -514,6 +520,27 @@ def test_critpath_whatif_counter_drift_triggers_t006(tmp_path):
     anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
     assert "critpath_bogus_chain" in anchors
     assert "whatif_bogus_pred" in anchors
+
+
+def test_operator_stacks_counter_drift_triggers_t006(tmp_path):
+    """The RNB-T006 family covers the operator-plane lines: the good
+    fixture (REPO_BENCH_LIKE, which writes the full Operator:/Stacks:
+    counter sets) is clean, and a bogus counter on either line
+    surfaces as exactly its drifted field."""
+    from rnb_tpu.analysis.schema import check_benchmark_result
+    good = tmp_path / "good_bench_like.py"
+    good.write_text(REPO_BENCH_LIKE)
+    assert check_benchmark_result(str(good), root=str(tmp_path)) == []
+    bad = tmp_path / "bad_bench_like.py"
+    bad.write_text(REPO_BENCH_LIKE
+                   .replace('errors=%d\\n',
+                            'errors=%d bogus_gets=%d\\n')
+                   .replace('total=%d\\n',
+                            'total=%d bogus_ticks=%d\\n'))
+    findings = check_benchmark_result(str(bad), root=str(tmp_path))
+    anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
+    assert "operator_bogus_gets" in anchors
+    assert "stacks_bogus_ticks" in anchors
 
 
 def test_schema_checker_clean_on_repo():

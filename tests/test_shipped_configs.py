@@ -161,6 +161,47 @@ def test_metrics_arm_ships_executed_with_overhead_in_the_noise():
         "flusher/bridge before re-executing the row" % ratio)
 
 
+def test_operator_arm_ships_executed_with_overhead_in_the_noise():
+    """The operator-plane headline cell (PR 15) must land in BOTH
+    configs/ and the matrix with an ok execution row, must declare the
+    root ``operator`` key (actions gated OFF per the honesty policy,
+    sampler ON) over the same topology as rnb-fused-yuv-metrics, and
+    the committed pair must back the overhead claim: serving the
+    operator server + continuous stack sampler costs videos/s within
+    the noise of the metrics baseline (>= 0.85x)."""
+    rel = "configs/rnb-fused-yuv-operator.json"
+    base = "configs/rnb-fused-yuv-metrics.json"
+    path = os.path.join(REPO, rel)
+    assert os.path.exists(path), rel
+    from rnb_tpu.config import load_config
+    cfg = load_config(path)
+    assert cfg.operator is not None \
+        and cfg.operator.get("enabled", True)
+    assert cfg.operator.get("allow_actions") is False, (
+        "the shipped operator arm must keep actuation opt-in "
+        "(allow_actions false) — introspection ships, control does "
+        "not")
+    assert cfg.operator.get("sample_hz", 1) > 0, (
+        "the shipped arm carries the always-on sampler (the overhead "
+        "claim covers it)")
+    base_cfg = load_config(os.path.join(REPO, base))
+    # same topology as the metrics baseline: the pair differs by the
+    # operator key alone, so the committed ratio IS the overhead
+    assert [s.model for s in cfg.steps] \
+        == [s.model for s in base_cfg.steps]
+    with open(ARTIFACT) as f:
+        rows = {r["config"]: r for r in json.load(f)["configs"]}
+    assert rel in rows and rows[rel].get("ok"), (
+        "the operator arm has no ok execution row — run "
+        "scripts/run_shipped_configs.py --only "
+        "'rnb-fused-yuv-operator.json'")
+    ratio = rows[rel]["videos_per_sec"] / rows[base]["videos_per_sec"]
+    assert ratio >= 0.85, (
+        "operator arm runs at %.2fx the metrics baseline — the "
+        "server/sampler overhead is no longer in the noise; profile "
+        "the sampler cadence before re-executing the row" % ratio)
+
+
 def test_dct_arm_ships_executed_with_half_the_wire_bytes():
     """The DCT-domain ingest headline cell (PR 12) must land in BOTH
     configs/ and the matrix with an ok execution row, must be the
