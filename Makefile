@@ -3,8 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: lint test native stamps trace ragged multichip chaos metrics dct \
-	devobs benchdiff explain operator
+.PHONY: lint test native stamps trace ragged multichip chaos netchaos \
+	metrics dct devobs benchdiff explain operator
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -53,6 +53,17 @@ multichip:
 # Health:/Deadline:/Hedge: invariants. Exit 0 = containment holds.
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_demo.py
+
+# Network chaos gate (README "Disaggregated ingest"): seeded network
+# faults against the cross-host netedge transport on the shipped
+# chaos arm — a mid-stream peer RST (recovered by reconnect+resend), a
+# silent 3 s wedge (the beat-staleness circuit must open BEFORE the
+# 2.5 s io timeout classifies it), and a fatal peer kill (refused
+# dials -> eviction -> local fallback) — asserting every request
+# terminates exactly once and parse_utils --check green including the
+# Net: wire-ledger footing. Exit 0 = containment holds.
+netchaos:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/netchaos_demo.py
 
 # Live-metrics gate (README "Live metrics"): a metrics+deadline arm
 # asserting >= 3 streamed snapshots, final-snapshot footing against

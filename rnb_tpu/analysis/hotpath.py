@@ -376,13 +376,43 @@ def _unbounded_wait_kind(node: ast.Call) -> Optional[str]:
     return ".%s()" % f.attr
 
 
+#: socket verbs whose blocking is bounded only by the SOCKET's
+#: configured timeout — unlike queue/event waits there is no per-call
+#: ``timeout=`` to demand, so the rule instead demands VISIBLE timeout
+#: discipline in the enclosing function: a ``settimeout(...)`` call
+#: (configuring the socket before/around the blocking verb) or a
+#: ``gettimeout()`` consult (guarding against an unconfigured one,
+#: the rnb_tpu.ops.wire.recv_exact idiom)
+_H009_SOCKET_ATTRS = {"recv", "recv_into", "accept", "connect"}
+
+#: the in-function evidence that a socket's blocking is bounded
+_H009_SOCKET_MARKERS = {"settimeout", "gettimeout"}
+
+
+def _socket_wait_kind(node: ast.Call) -> Optional[str]:
+    """Classify one call as a timeout-governed socket verb, or None."""
+    f = node.func
+    if not isinstance(f, ast.Attribute) \
+            or f.attr not in _H009_SOCKET_ATTRS:
+        return None
+    return ".%s()" % f.attr
+
+
 def _lint_unbounded_waits(rel: str, index: _ModuleIndex,
                           findings: List[Finding],
                           hot: Set[str]) -> None:
     """RNB-H009 over the hot set plus every ``wait`` method — the
     blocking leaf hot paths call through cross-object (the intra-
     module call graph cannot follow ``handle.wait()``), so the leaves
-    are linted under their own anchors."""
+    are linted under their own anchors.
+
+    Socket verbs (recv/recv_into/accept/connect) are linted over
+    EVERY function for the same leaf reason — receiver loops are
+    thread targets the hot-root graph cannot reach — and their
+    compliance evidence is per-function: the socket's timeout cannot
+    ride the call, so the function that blocks must be the one seen
+    configuring (``settimeout``) or guarding (``gettimeout``) it.
+    """
     scope = set(hot)
     for qual in index.functions:
         name = qual.rsplit(".", 1)[-1]
@@ -403,6 +433,28 @@ def _lint_unbounded_waits(rel: str, index: _ModuleIndex,
                     "dead counterpart hangs this thread forever; "
                     "bound the wait and re-check liveness each lap, "
                     "or baseline it with the justification" % kind))
+    for qual in sorted(index.functions):
+        node = index.functions[qual]
+        bounded = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _H009_SOCKET_MARKERS
+            for sub in _own_walk(node))
+        if bounded:
+            continue
+        for sub in _own_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            kind = _socket_wait_kind(sub)
+            if kind is not None:
+                findings.append(Finding(
+                    "RNB-H009", rel, sub.lineno, qual,
+                    "socket%s with no configured timeout in sight — "
+                    "a silently dead peer blocks this thread forever "
+                    "instead of classifying as net_timeout; settimeout "
+                    "the socket (or gettimeout-guard it) in this "
+                    "function, or baseline it with the justification"
+                    % kind))
 
 
 def _lint_fault_determinism(rel: str, index: _ModuleIndex,

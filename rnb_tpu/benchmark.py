@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue
 import shutil
 import sys
 import threading
@@ -335,6 +336,27 @@ class BenchmarkResult:
     stacks_threads: int = 0
     stacks_folded: int = 0
     stacks_total: int = 0
+    # netedge transport ledger (root 'netedge' key; rnb_tpu.netedge)
+    net_frames_sent: int = 0
+    net_frames_acked: int = 0
+    net_resent_pending: int = 0
+    net_resends: int = 0
+    net_beats: int = 0
+    net_reconnects: int = 0
+    net_remote: int = 0
+    net_local: int = 0
+    net_dedup_drops: int = 0
+    net_dup_arrivals: int = 0
+    net_wire_bytes: int = 0
+    net_frame_bytes: int = 0
+    net_window_stranded: int = 0
+    net_open_before_timeout: int = 0
+    net_err_total: int = 0
+    net_err_refused: int = 0
+    net_err_reset: int = 0
+    net_err_timeout: int = 0
+    net_err_partial_frame: int = 0
+    net_err_corrupt: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -494,7 +516,10 @@ def run_benchmark(config_path: str,
                                       health_settings)
             for step_idx, step in enumerate(config.steps)
             if step.replica_queues}
-        if not boards_by_step:
+        if not boards_by_step and not (config.netedge
+                                       or {}).get("enabled"):
+            # a netedge run has no replica lanes but DOES have a lane
+            # to circuit-break — the remote peer's board
             print("[rnb-tpu] WARNING: health is enabled but no step "
                   "declares replica lanes — there is nothing to "
                   "circuit-break and no Health: telemetry will be "
@@ -526,6 +551,21 @@ def run_benchmark(config_path: str,
                 "but the config has no enabled root 'health' key (or "
                 "no replica lanes) — lane deaths need the health "
                 "layer's eviction/drain machinery to stay contained")
+    # cross-host ingest edge (rnb_tpu.netedge, root 'netedge' key):
+    # a peer process serves step 0 over the wire with a local
+    # fallback path behind a dedicated LaneHealthBoard
+    from rnb_tpu.netedge import (NET_LANE, NetEdgeClient,
+                                 NetEdgeSettings, NetStats, spawn_peer)
+    netedge_settings = NetEdgeSettings.from_config(config.netedge)
+    if fault_plan is not None and fault_plan.has_net_faults() \
+            and netedge_settings is None:
+        # same loud-typo posture as LANE_KINDS without replicas: a
+        # net fault with no edge never fires, and the chaos run would
+        # read 'containment verified' with zero injections
+        raise ValueError(
+            "the fault plan injects net_* faults but the config has "
+            "no enabled root 'netedge' key — there is no network "
+            "edge to address")
     if fault_plan is not None and print_progress:
         print("[rnb-tpu] fault plan active: %s" % fault_plan.describe())
 
@@ -546,6 +586,40 @@ def run_benchmark(config_path: str,
         effective_queue_size = (num_videos * seg_factor + num_runners
                                 + max(NUM_EXIT_MARKERS, num_runners) + 1)
     fabric = ChannelFabric(config, effective_queue_size)
+    # netedge interposition: the dispatcher becomes the filename
+    # queue's sole consumer; step-0 executors read this local queue
+    # instead (same capacity, same item/marker protocol), and the
+    # receiver injects remote emissions straight into step 0's first
+    # out-queue as DirectPayload items
+    netedge_client = None
+    netedge_stats = None
+    netedge_board = None
+    netedge_peer = None
+    netedge_local_q = None
+    if netedge_settings is not None:
+        if netedge_settings.spawn:
+            netedge_peer, peer_addr = spawn_peer(
+                config_path, netedge_settings, seed=seed or 0)
+            netedge_settings.connect = peer_addr
+        netedge_board = LaneHealthBoard(
+            (NET_LANE,), health_settings or HealthSettings())
+        netedge_stats = NetStats()
+        netedge_local_q = queue.Queue(maxsize=effective_queue_size)
+        netedge_client = NetEdgeClient(
+            netedge_settings,
+            board=netedge_board,
+            stats=netedge_stats,
+            fault_plan=fault_plan,
+            fault_stats=fault_stats,
+            deadline_stats=deadline_stats,
+            counter=counter,
+            num_videos=num_videos,
+            termination=termination,
+            filename_queue=fabric.get_filename_queue(),
+            local_queue=netedge_local_q,
+            inject_queue=fabric.get_queues(0, 0)[1][0],
+            num_markers=fabric.filename_num_markers,
+            seed=seed or 0)
     # one queue-occupancy probe list — (series name, qsize fn,
     # capacity) per edge in step-major enumeration order — shared by
     # the metrics gauge sources and the operator server's /statusz so
@@ -637,6 +711,18 @@ def run_benchmark(config_path: str,
         for board in boards_by_step.values():
             metrics_registry.add_poll(metrics_mod.snapshot_poll(
                 "health", board.snapshot,
+                counters=("transitions", "opens", "evictions",
+                          "probes", "redispatches")))
+        if netedge_stats is not None:
+            metrics_registry.add_poll(metrics_mod.snapshot_poll(
+                "net", netedge_stats.snapshot,
+                counters=("frames_sent", "frames_acked", "resends",
+                          "beats", "reconnects", "remote", "local",
+                          "dedup_drops", "dup_arrivals", "wire_bytes",
+                          "frame_bytes", "err_total"),
+                gauges=("peer_depth",)))
+            metrics_registry.add_poll(metrics_mod.snapshot_poll(
+                "health", netedge_board.snapshot,
                 counters=("transitions", "opens", "evictions",
                           "probes", "redispatches")))
         bridge = metrics_mod.SpanBridge(
@@ -754,6 +840,11 @@ def run_benchmark(config_path: str,
             for instance_idx, device in enumerate(group.devices):
                 in_queue, out_queues = fabric.get_queues(step_idx,
                                                          group_idx)
+                if netedge_local_q is not None and step_idx == 0:
+                    # netedge: the dispatcher owns the filename
+                    # queue; local step-0 executors serve the
+                    # fallback path off the interposed local queue
+                    in_queue = netedge_local_q
                 ctx = RunnerContext(
                     in_queue=in_queue,
                     out_queues=out_queues,
@@ -839,6 +930,10 @@ def run_benchmark(config_path: str,
 
     for t in threads:
         t.start()
+
+    if netedge_client is not None:
+        # transport threads, not stages: they never join the barriers
+        netedge_client.start()
 
     if xprof:
         # device-op tracing of the measured window only: wait until
@@ -967,6 +1062,24 @@ def run_benchmark(config_path: str,
     for t in threads:
         t.join(timeout=60)
 
+    if netedge_client is not None:
+        # after the stage joins: the window is drained (or rerouted),
+        # so teardown counters are final. Remote cards carry the
+        # peer loader's pad_rows stamps but the peer's PadCounter
+        # dies with the peer — the receiver's re-count of shipped
+        # emissions keeps the Padding: ledger covering them (--check
+        # holds per-request trailer pads <= the meta counter)
+        netedge_client.stop()
+        netedge_pads = netedge_client.pad_snapshot()
+        if netedge_pads["emissions"]:
+            pad_sink.append(netedge_pads)
+    if netedge_peer is not None:
+        netedge_peer.terminate()
+        try:
+            netedge_peer.wait(timeout=10)
+        except Exception:
+            netedge_peer.kill()
+
     if metrics_registry is not None:
         # stop bridging the trace hooks (the tracer export below
         # reads its own buffer, not the module hook); the registry
@@ -1093,12 +1206,16 @@ def run_benchmark(config_path: str,
     # self-healing accounting (rnb_tpu.health): boards/governors are
     # shared objects, stable once every thread joined above
     health_stats = None
-    if boards_by_step:
+    if boards_by_step or netedge_board is not None:
         from rnb_tpu.health import aggregate_board_snapshots
-        health_stats = aggregate_board_snapshots(
-            [b.snapshot() for b in boards_by_step.values()])
+        snapshots = [b.snapshot() for b in boards_by_step.values()]
+        if netedge_board is not None:
+            snapshots.append(netedge_board.snapshot())
+        health_stats = aggregate_board_snapshots(snapshots)
     deadline_snap = (deadline_stats.snapshot()
                      if deadline_stats is not None else None)
+    net_snap = (netedge_stats.snapshot()
+                if netedge_stats is not None else None)
     hedge_stats = None
     if governors_by_step:
         from rnb_tpu.health import aggregate_hedge_snapshots
@@ -1440,6 +1557,32 @@ def run_benchmark(config_path: str,
                        stacks_summary["threads"],
                        stacks_summary["folded"],
                        stacks_summary["total"]))
+        if net_snap is not None:
+            # the edge's exactly-once ledger, cross-footed by --check:
+            # frames_sent == frames_acked + resent_pending, dedup
+            # drops == dup arrivals, zero stranded on target-reached
+            f.write("Net: frames_sent=%d frames_acked=%d "
+                    "resent_pending=%d resends=%d beats=%d "
+                    "reconnects=%d remote=%d local=%d dedup_drops=%d "
+                    "dup_arrivals=%d wire_bytes=%d frame_bytes=%d "
+                    "window_stranded=%d open_before_timeout=%d\n"
+                    % (net_snap["frames_sent"],
+                       net_snap["frames_acked"],
+                       net_snap["resent_pending"],
+                       net_snap["resends"], net_snap["beats"],
+                       net_snap["reconnects"], net_snap["remote"],
+                       net_snap["local"], net_snap["dedup_drops"],
+                       net_snap["dup_arrivals"],
+                       net_snap["wire_bytes"],
+                       net_snap["frame_bytes"],
+                       net_snap["window_stranded"],
+                       net_snap["open_before_timeout"]))
+            f.write("Net errors: total=%d refused=%d reset=%d "
+                    "timeout=%d partial_frame=%d corrupt=%d\n"
+                    % (net_snap["err_total"], net_snap["err_refused"],
+                       net_snap["err_reset"], net_snap["err_timeout"],
+                       net_snap["err_partial_frame"],
+                       net_snap["err_corrupt"]))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -1560,6 +1703,14 @@ def run_benchmark(config_path: str,
               "original, %d ms of loser service wasted"
               % (hedge_stats["fired"], hedge_stats["won"],
                  hedge_stats["lost"], hedge_stats["wasted_ms"]))
+    if net_snap is not None and print_progress:
+        print("Net: %d frame(s) sent / %d acked, %d resend(s), "
+              "%d reconnect(s), %d remote / %d local route(s), "
+              "%d error(s)"
+              % (net_snap["frames_sent"], net_snap["frames_acked"],
+                 net_snap["resends"], net_snap["reconnects"],
+                 net_snap["remote"], net_snap["local"],
+                 net_snap["err_total"]))
     if ragged_stats is not None and print_progress:
         print("Ragged: %d emission(s), %d valid row(s) at pool_rows=%d"
               ", %d pad row(s) eliminated vs the bucketed rule, "
@@ -1826,6 +1977,30 @@ def run_benchmark(config_path: str,
                        if stacks_summary else 0),
         stacks_total=(stacks_summary["total"]
                       if stacks_summary else 0),
+        net_frames_sent=(net_snap["frames_sent"] if net_snap else 0),
+        net_frames_acked=(net_snap["frames_acked"] if net_snap else 0),
+        net_resent_pending=(net_snap["resent_pending"]
+                            if net_snap else 0),
+        net_resends=(net_snap["resends"] if net_snap else 0),
+        net_beats=(net_snap["beats"] if net_snap else 0),
+        net_reconnects=(net_snap["reconnects"] if net_snap else 0),
+        net_remote=(net_snap["remote"] if net_snap else 0),
+        net_local=(net_snap["local"] if net_snap else 0),
+        net_dedup_drops=(net_snap["dedup_drops"] if net_snap else 0),
+        net_dup_arrivals=(net_snap["dup_arrivals"] if net_snap else 0),
+        net_wire_bytes=(net_snap["wire_bytes"] if net_snap else 0),
+        net_frame_bytes=(net_snap["frame_bytes"] if net_snap else 0),
+        net_window_stranded=(net_snap["window_stranded"]
+                             if net_snap else 0),
+        net_open_before_timeout=(net_snap["open_before_timeout"]
+                                 if net_snap else 0),
+        net_err_total=(net_snap["err_total"] if net_snap else 0),
+        net_err_refused=(net_snap["err_refused"] if net_snap else 0),
+        net_err_reset=(net_snap["err_reset"] if net_snap else 0),
+        net_err_timeout=(net_snap["err_timeout"] if net_snap else 0),
+        net_err_partial_frame=(net_snap["err_partial_frame"]
+                               if net_snap else 0),
+        net_err_corrupt=(net_snap["err_corrupt"] if net_snap else 0),
     )
 
 

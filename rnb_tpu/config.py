@@ -38,7 +38,8 @@ ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
     "trace", "ragged", "handoff", "placement", "health", "deadline",
-    "metrics", "devobs", "critpath", "whatif", "operator", "_comment",
+    "metrics", "devobs", "critpath", "whatif", "operator", "netedge",
+    "_comment",
 ]
 
 #: keys a root 'popularity' object may carry
@@ -88,6 +89,11 @@ WHATIF_KEYWORDS = ["enabled"]
 
 #: keys a root 'operator' object may carry (rnb_tpu.statusz)
 OPERATOR_KEYWORDS = ["enabled", "port", "allow_actions", "sample_hz"]
+
+#: keys a root 'netedge' object may carry (rnb_tpu.netedge)
+NETEDGE_KEYWORDS = ["enabled", "listen", "connect", "beat_ms",
+                    "io_timeout_ms", "max_retries", "backoff_ms",
+                    "resend_window", "spawn"]
 
 #: Ring slots per stage instance when a step omits 'num_shared_tensors'
 #: (reference control.py:8). Lives here (not control.py) so validation
@@ -284,6 +290,17 @@ class PipelineConfig:
     #: allow_actions is true. Absent => no server, no sampler,
     #: byte-stable logs.
     operator: Optional[Dict[str, Any]] = None
+    #: validated cross-host ingest-edge spec ({"enabled": ..,
+    #: "listen": .., "connect": .., "beat_ms": ..,
+    #: "io_timeout_ms": .., "max_retries": .., "backoff_ms": ..,
+    #: "resend_window": .., "spawn": ..}), or None; when enabled the
+    #: launcher interposes the rnb_tpu.netedge transport between the
+    #: client and step 0: requests route over a checksummed TCP frame
+    #: protocol to an ingest peer process (spawn: true launches it)
+    #: with a local fallback path behind a LaneHealthBoard, and
+    #: log-meta gains the Net:/Net errors: lines. Absent => in-process
+    #: queues, byte-stable logs.
+    netedge: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -820,6 +837,47 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                 "(0 disables the wall-clock stack sampler), got %r"
                 % (op_hz,))
 
+    netedge = raw.get("netedge")
+    if netedge is not None:
+        _expect(isinstance(netedge, dict),
+                "'netedge' must be an object")
+        unknown_ne = sorted(set(netedge) - set(NETEDGE_KEYWORDS))
+        _expect(not unknown_ne,
+                "'netedge' has unknown key(s) %s — keys are %s"
+                % (unknown_ne, NETEDGE_KEYWORDS))
+        _expect(isinstance(netedge.get("enabled", True), bool),
+                "'netedge.enabled' must be a boolean")
+        _expect(isinstance(netedge.get("spawn", False), bool),
+                "'netedge.spawn' must be a boolean")
+        for key in ("listen", "connect"):
+            val = netedge.get(key)
+            _expect(val is None or isinstance(val, str),
+                    "'netedge.%s' must be a host:port string, got %r"
+                    % (key, val))
+        for key in ("beat_ms", "io_timeout_ms", "backoff_ms"):
+            val = netedge.get(key)
+            _expect(val is None
+                    or (isinstance(val, (int, float))
+                        and not isinstance(val, bool) and val >= 0),
+                    "'netedge.%s' must be a non-negative number, "
+                    "got %r" % (key, val))
+        for key in ("max_retries", "resend_window"):
+            val = netedge.get(key)
+            _expect(val is None
+                    or (isinstance(val, int)
+                        and not isinstance(val, bool) and val >= 1),
+                    "'netedge.%s' must be a positive integer, got %r"
+                    % (key, val))
+        if netedge.get("enabled", True):
+            # the same defaulting the runtime applies — a timeout
+            # shorter than the heartbeat, or neither connect nor
+            # spawn, must fail at parse time, not at launch
+            try:
+                from rnb_tpu.netedge import NetEdgeSettings
+                NetEdgeSettings.from_config(netedge)
+            except ValueError as e:
+                raise ConfigError("invalid 'netedge': %s" % e) from e
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -1017,6 +1075,34 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                                     step_idx),
                                 hedge_ms=hedge_ms))
 
+    if netedge is not None and netedge.get("enabled", True):
+        # the remote peer serves step 0 and the receiver injects its
+        # outputs into step 0's out-queue — both need a downstream
+        # step to exist and the local/remote emission paths to be
+        # interchangeable; features that break that symmetry are
+        # rejected loudly rather than silently mis-accounted
+        _expect(len(steps) >= 2,
+                "'netedge' needs at least 2 pipeline steps: the peer "
+                "serves step 0 remotely and injects into step 1's "
+                "input edge")
+        _expect(steps[0].num_segments == 1,
+                "'netedge' cannot serve a segmented step 0: the "
+                "remote path bypasses the runner's segment split")
+        _expect(not (isinstance(trace, dict)
+                     and trace.get("enabled", True)),
+                "'netedge' cannot be combined with 'trace': remote "
+                "emissions lack the trace-mode decode stamps, so the "
+                "per-request timing tables would mix two schemas")
+        _expect(not (isinstance(ragged, dict)
+                     and ragged.get("enabled", True)),
+                "'netedge' cannot be combined with 'ragged': the "
+                "peer's row-pool accounting dies with the peer")
+        _expect(all(s.replica_queues is None for s in steps),
+                "'netedge' cannot be combined with replica-expanded "
+                "steps (or hedging/apply-mode placement): injected "
+                "remote emissions bypass the replica in-flight depth "
+                "accounting")
+
     return PipelineConfig(video_path_iterator=raw["video_path_iterator"],
                           steps=steps, raw=raw,
                           overload_policy=overload_policy,
@@ -1034,4 +1120,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           metrics=metrics,
                           devobs=devobs,
                           operator=operator,
+                          netedge=netedge,
                           trace=trace)

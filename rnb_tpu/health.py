@@ -416,6 +416,39 @@ class LaneHealthBoard:
                     break
             return allowed, probe
 
+    def consult_and_route(self, queue_idx: int,
+                          now: Optional[float] = None) -> bool:
+        """Atomic single-lane routing decision: evaluate transitions
+        and, in the same locked step, either claim the route (True) or
+        refuse it (False, caller goes elsewhere).
+
+        The split ``route_filter`` + ``note_route`` consult leaves a
+        window where another thread's evaluation flips the lane OPEN
+        between the caller's check and its note — which would count a
+        ``routes_after_open`` violation against a dispatch that was
+        decided while the lane was still routable. A caller with a
+        single candidate lane and a fallback path (the netedge
+        dispatcher) uses this instead: the decision and the
+        accounting share one lock acquisition, so a route claimed
+        here is by construction never a containment violation.
+        Healthy/suspect route; a half-open lane grants exactly one
+        probe (the claimer must dispatch it); open/evicted refuse.
+        """
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._evaluate_locked(now)
+            lane = self._lanes.get(queue_idx)
+            if lane is None:
+                return False
+            if lane.state in (HEALTHY, SUSPECT):
+                return True
+            if lane.state == HALF_OPEN and not lane.probe_outstanding:
+                lane.probe_outstanding = True
+                lane.probe_t = now
+                self.num_probes += 1
+                return True
+            return False
+
     def note_route(self, queue_idx: int, forced: bool = False) -> None:
         """One dispatch routed to the lane. A route landing on an
         open/evicted lane while routable siblings existed is the

@@ -1,0 +1,284 @@
+"""Length-prefixed, checksummed frame protocol for the cross-host
+ingest edge (rnb_tpu.netedge).
+
+One frame = a fixed 28-byte little-endian header followed by
+``length`` payload bytes:
+
+    u32  length    payload byte count (not counting the header)
+    u8   type      REQ | ACK | DATA | BEAT | DISPOSE | EOS
+    u8   flags     reserved (0)
+    u16  depth     sender's in-flight request count at send time —
+                   the per-lane depth signal the health board consumes,
+                   piggybacked on EVERY frame so acks and beats both
+                   refresh it
+    u64  seq       sender-assigned sequence number of the REQ this
+                   frame belongs to (0 on BEAT/EOS); ACK/DATA/DISPOSE
+                   echo it, and both sides' dedup ledgers key on it
+    f64  deadline  the request's absolute ``deadline_s`` stamp (0.0
+                   when no deadline is set) — in the HEADER so expiry
+                   shedding can fire on either side of the edge
+                   without decoding the payload
+    u32  crc       CRC32 over the 24 preceding header bytes + payload
+
+Payloads are JSON (REQ/DISPOSE), empty (ACK/BEAT/EOS), or JSON meta +
+raw row bytes (DATA). DATA ships ONLY the ``valid`` leading rows of
+the batch — for the packed DCT pixel path that is exactly
+``dct_frame_elems`` int16 elements per frame (9 408 B at the default
+budget, the wire format PR 12 built for this edge); the receiver
+re-pads to the static shipped shape with zeros, which is what the pad
+rows contain by construction.
+
+Error classification (the PR 1 taxonomy, see rnb_tpu.faults):
+
+    CRC mismatch              -> NetCorruptFrameError   (permanent)
+    EOF inside a frame        -> NetPartialFrameError   (transient)
+    EOF at a frame boundary   -> NetResetError          (transient)
+    ECONNRESET / EPIPE        -> NetResetError          (transient)
+    socket timeout            -> NetTimeoutError        (transient)
+    dial refused              -> NetRefusedError        (transient)
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from rnb_tpu.faults import (NetCorruptFrameError, NetPartialFrameError,
+                            NetRefusedError, NetResetError,
+                            NetTimeoutError)
+
+#: frame types
+REQ = 1       # main -> peer: one request (path + serialized TimeCard)
+ACK = 2       # peer -> main: REQ accepted (resend suppression + depth)
+DATA = 3      # peer -> main: the stage's output rows for one REQ
+BEAT = 4      # peer -> main: liveness heartbeat (depth piggybacked)
+DISPOSE = 5   # peer -> main: terminal non-output outcome (failed/shed)
+EOS = 6       # main -> peer: no more REQs; drain and exit
+
+FRAME_NAMES = {REQ: "REQ", ACK: "ACK", DATA: "DATA", BEAT: "BEAT",
+               DISPOSE: "DISPOSE", EOS: "EOS"}
+
+#: header minus the trailing crc, and the crc tail
+_HEAD = struct.Struct("<IBBHQd")
+_CRC = struct.Struct("<I")
+HEADER_SIZE = _HEAD.size + _CRC.size
+
+
+def encode_frame(ftype: int, payload: bytes = b"", seq: int = 0,
+                 deadline: float = 0.0, depth: int = 0,
+                 flags: int = 0) -> bytes:
+    """One wire-ready frame. ``depth`` saturates at u16 max rather
+    than wrapping — a depth gauge that lies small under pathological
+    backlog would mask exactly the overload it exists to show."""
+    head = _HEAD.pack(len(payload), ftype, flags, min(depth, 0xffff),
+                      seq, deadline)
+    crc = zlib.crc32(payload, zlib.crc32(head)) & 0xffffffff
+    return head + _CRC.pack(crc) + payload
+
+
+def classify_io_error(exc: BaseException) -> Optional[Exception]:
+    """Map a raw socket exception onto the net taxonomy, or None if it
+    is not a recognized network failure (caller re-raises those)."""
+    if isinstance(exc, socket.timeout):
+        return NetTimeoutError(str(exc) or "socket timeout")
+    if isinstance(exc, ConnectionRefusedError):
+        return NetRefusedError(str(exc))
+    if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                        ConnectionAbortedError)):
+        return NetResetError(str(exc))
+    return None
+
+
+def recv_exact(sock: socket.socket, n: int, *,
+               mid_frame: bool) -> bytes:
+    """Exactly ``n`` bytes off ``sock`` or a classified net error.
+
+    EOF before the first byte of a frame header is a dead connection
+    (:class:`NetResetError`); EOF anywhere else — including between
+    the header and its payload — is a short frame
+    (:class:`NetPartialFrameError`): framing is lost either way, but
+    the distinction feeds separate per-class counters so a chaos
+    plan's ``net_partial_frame`` injections are visible as themselves.
+    """
+    if sock.gettimeout() is None:
+        # an unbounded blocking recv hangs the receiver forever on a
+        # silently dead peer — the transport's whole fault taxonomy
+        # depends on this read surfacing as net_timeout instead
+        raise ValueError("recv_exact needs a socket with a configured "
+                         "timeout (sock.settimeout)")
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(n - got)
+        except Exception as exc:  # noqa: BLE001 - classified below
+            net = classify_io_error(exc)
+            if net is not None:
+                raise net from exc
+            raise
+        if not chunk:
+            if mid_frame or got:
+                raise NetPartialFrameError(
+                    "stream ended %d bytes into a %d-byte read"
+                    % (got, n))
+            raise NetResetError("connection closed by peer")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket
+               ) -> Tuple[int, int, int, int, float, bytes]:
+    """-> (type, flags, depth, seq, deadline, payload) or a classified
+    net error. The CRC check covers header and payload together, so a
+    flipped byte anywhere in the frame surfaces as
+    :class:`NetCorruptFrameError` — but only AFTER the full frame was
+    consumed, so framing stays in sync and the connection survives a
+    corrupt frame (the request it carried does not)."""
+    head = recv_exact(sock, _HEAD.size, mid_frame=False)
+    (crc_stored,) = _CRC.unpack(
+        recv_exact(sock, _CRC.size, mid_frame=True))
+    length, ftype, flags, depth, seq, deadline = _HEAD.unpack(head)
+    payload = recv_exact(sock, length, mid_frame=True) if length \
+        else b""
+    crc = zlib.crc32(payload, zlib.crc32(head)) & 0xffffffff
+    if crc != crc_stored:
+        exc = NetCorruptFrameError(
+            "crc mismatch on %s frame seq=%d (%08x != %08x)"
+            % (FRAME_NAMES.get(ftype, ftype), seq, crc, crc_stored))
+        exc.seq = seq  # receiver dead-letters exactly this request
+        raise exc
+    return ftype, flags, depth, seq, deadline, payload
+
+
+def send_frame(sock: socket.socket, frame: bytes) -> None:
+    """sendall with the same classification as the receive side."""
+    try:
+        sock.sendall(frame)
+    except Exception as exc:  # noqa: BLE001 - classified below
+        net = classify_io_error(exc)
+        if net is not None:
+            raise net from exc
+        raise
+
+
+# -- TimeCard serialization -------------------------------------------
+
+def card_to_wire(card) -> dict:
+    """JSON-safe dict carrying EVERYTHING a TimeCard owns: identity,
+    the ordered timing stamps, the device trail, the outcome fields,
+    and every declared content stamp that is set (absent stamps stay
+    absent — presence is part of the telemetry schema; fabricating a
+    default would corrupt e.g. deadline-off accounting)."""
+    from rnb_tpu.telemetry import CONTENT_STAMPS
+    stamps = {}
+    for attr in CONTENT_STAMPS:
+        if hasattr(card, attr):
+            stamps[attr] = getattr(card, attr)
+    return {"id": card.id, "sub_id": card.sub_id,
+            "timings": [[k, t] for k, t in card.timings.items()],
+            "devices": [list(d) for d in card.devices],
+            "status": card.status,
+            "failure_reason": card.failure_reason,
+            "stamps": stamps}
+
+
+def card_from_wire(d: dict):
+    """Inverse of :func:`card_to_wire`."""
+    from rnb_tpu.telemetry import TimeCard
+    card = TimeCard(int(d["id"]))
+    card.sub_id = d.get("sub_id")
+    card.timings = OrderedDict((k, float(t)) for k, t in d["timings"])
+    card.devices = [tuple(dev) for dev in d.get("devices", [])]
+    card.status = d.get("status", "ok")
+    card.failure_reason = d.get("failure_reason")
+    for attr, value in d.get("stamps", {}).items():
+        setattr(card, attr, value)
+    return card
+
+
+# -- REQ / DISPOSE payloads -------------------------------------------
+
+def encode_req(path: str, card) -> bytes:
+    return json.dumps({"path": path, "card": card_to_wire(card)},
+                      sort_keys=True).encode("utf-8")
+
+
+def decode_req(payload: bytes) -> Tuple[str, Any]:
+    d = json.loads(payload.decode("utf-8"))
+    return d["path"], card_from_wire(d["card"])
+
+
+def encode_dispose(outcome: str, reason: str, card) -> bytes:
+    """``outcome`` is "failed" (peer dead-lettered the request) or
+    "shed" (peer shed it at its receive boundary)."""
+    return json.dumps({"outcome": outcome, "reason": reason,
+                       "card": card_to_wire(card)},
+                      sort_keys=True).encode("utf-8")
+
+
+def decode_dispose(payload: bytes) -> Tuple[str, str, Any]:
+    d = json.loads(payload.decode("utf-8"))
+    return d["outcome"], d["reason"], card_from_wire(d["card"])
+
+
+# -- DATA payload (batch rows + meta) ---------------------------------
+
+def encode_data(batch, non_tensors, card) -> bytes:
+    """u32 meta length + JSON meta + the raw bytes of the VALID rows.
+
+    Only single-request emissions are wire-able (seq <-> request is
+    1:1; that is what makes the exactly-once ledger sound), so fusing
+    loaders stay in-process — enforced here, loudly.
+    """
+    from rnb_tpu.stage import RaggedBatch
+    if not hasattr(card, "timings"):
+        raise ValueError(
+            "netedge wire carries single-request emissions only "
+            "(got %s — fusing loaders are not wire-able)"
+            % type(card).__name__)
+    data = np.asarray(batch.data)
+    valid = int(batch.valid)
+    rows = np.ascontiguousarray(data[:valid])
+    meta = {"kind": ("ragged" if isinstance(batch, RaggedBatch)
+                     else "padded"),
+            "shape": list(data.shape), "dtype": data.dtype.name,
+            "valid": valid,
+            "offsets": (list(batch.segment_offsets)
+                        if isinstance(batch, RaggedBatch) else None),
+            "non_tensors": non_tensors,
+            "card": card_to_wire(card)}
+    mj = json.dumps(meta, sort_keys=True).encode("utf-8")
+    return struct.pack("<I", len(mj)) + mj + rows.tobytes()
+
+
+def decode_data(payload: bytes) -> Tuple[Any, Any, Any, int]:
+    """-> (batch, non_tensors, card, row_bytes). The receiver side
+    re-pads to the static shipped shape with zeros — bit-identical to
+    what the in-process loader emits, because pad rows ARE zeros."""
+    from rnb_tpu.stage import PaddedBatch, RaggedBatch
+    (mlen,) = struct.unpack_from("<I", payload, 0)
+    meta = json.loads(payload[4:4 + mlen].decode("utf-8"))
+    raw = payload[4 + mlen:]
+    shape = tuple(int(s) for s in meta["shape"])
+    dtype = np.dtype(meta["dtype"])
+    valid = int(meta["valid"])
+    rows = np.frombuffer(raw, dtype=dtype).reshape(
+        (valid,) + shape[1:]) if valid else \
+        np.zeros((0,) + shape[1:], dtype=dtype)
+    data = np.zeros(shape, dtype=dtype)
+    if valid:
+        data[:valid] = rows
+    if meta["kind"] == "ragged":
+        batch = RaggedBatch(data, valid,
+                            tuple(meta["offsets"] or (0, 0)))
+    else:
+        batch = PaddedBatch(data, valid)
+    return batch, meta["non_tensors"], card_from_wire(meta["card"]), \
+        len(raw)

@@ -301,6 +301,20 @@ META_LINE_REGISTRY = (
               "thread samples (operator runs with sample_hz > 0 "
               "only; --check re-sums stacks.folded to total and "
               "holds ticks to sample_hz x wall within tolerance)"),
+    StampSpec("Net:", "rnb_tpu/benchmark.py",
+              "cross-host ingest edge counters (rnb_tpu.netedge): "
+              "frames sent/acked, resends + resent_pending at "
+              "teardown, heartbeats seen, reconnect cycles, "
+              "remote vs local-fallback dispatch split, dedup drops "
+              "vs duplicate arrivals, wire/frame byte totals, "
+              "window strands, opened-before-timeout flag (netedge-"
+              "enabled runs only; --check holds "
+              "frames_sent == frames_acked + resent_pending and "
+              "dedup_drops == dup_arrivals)"),
+    StampSpec("Net errors:", "rnb_tpu/benchmark.py",
+              "per-class network fault counts off the PR 1 taxonomy "
+              "(refused/reset/timeout/partial_frame/corrupt); "
+              "--check re-sums the classes to total"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry
@@ -490,6 +504,34 @@ METRIC_REGISTRY = (
                "half-open recovery probes"),
     MetricSpec("health.redispatches", "counter", "poll",
                "items drained off evicted lanes onto siblings"),
+    MetricSpec("net.frames_sent", "counter", "poll",
+               "REQ frames shipped across the ingest edge"),
+    MetricSpec("net.frames_acked", "counter", "poll",
+               "REQ frames the peer acknowledged (unique seqs)"),
+    MetricSpec("net.resends", "counter", "poll",
+               "REQ frames re-shipped after reconnect or ack loss"),
+    MetricSpec("net.beats", "counter", "poll",
+               "peer heartbeat frames received"),
+    MetricSpec("net.reconnects", "counter", "poll",
+               "successful re-dials after a connection died"),
+    MetricSpec("net.remote", "counter", "poll",
+               "requests dispatched across the wire"),
+    MetricSpec("net.local", "counter", "poll",
+               "requests routed to the in-process fallback"),
+    MetricSpec("net.dedup_drops", "counter", "poll",
+               "duplicate DATA/DISPOSE frames dropped by the "
+               "receiver-side ledger (exactly-once guard)"),
+    MetricSpec("net.dup_arrivals", "counter", "poll",
+               "frames that arrived for an already-settled seq"),
+    MetricSpec("net.wire_bytes", "counter", "poll",
+               "total bytes received off the wire"),
+    MetricSpec("net.frame_bytes", "counter", "poll",
+               "DATA row-payload bytes received (valid rows only)"),
+    MetricSpec("net.err_total", "counter", "poll",
+               "classified network faults observed (all classes)"),
+    MetricSpec("net.peer_depth", "gauge", "poll",
+               "peer-reported in-flight depth (piggybacked on "
+               "every ack/beat frame)"),
     # -- stage-owned subsystems (polled via metrics.register_stage) ---
     MetricSpec("cache.hits", "counter", "poll",
                "clip-cache lookup hits"),

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""``make netchaos``: seeded network-fault containment over a REAL wire.
+
+Runs the SHIPPED network chaos arm (configs/rnb-netedge-chaos.json —
+the reduced-geometry 2-step pipeline with its loader stage served by a
+genuine second python process over the rnb_tpu.netedge TCP transport)
+through ``run_benchmark``, with the seeded fault plan staging three
+acts against the connection mid-stream:
+
+1. a non-fatal ``net_reset`` (request 2): the peer RSTs the socket
+   before acking — the capped-backoff redial plus the resend window
+   must recover it invisibly (>= 1 successful reconnect);
+2. a ``net_timeout`` (request 8): the peer acks, then wedges silently
+   for 1.5 s — beats pause too, so the missing-liveness signal must
+   walk the lane suspect -> OPEN *before* the 1.2 s io timeout ever
+   classifies the stall (``net_open_before_timeout == 1``: the circuit
+   beats the timeout), with fresh arrivals draining to the in-process
+   fallback while the circuit is open, and a probe healing the lane
+   once the peer wakes;
+3. a FATAL ``net_reset`` (request 24): with the lane healed and
+   traffic remote again, the peer process dies with no goodbye —
+   every redial is refused, the lane is EVICTED with a legal
+   transition log, the resend window reroutes locally, and the run
+   finishes on the fallback path.
+
+The three acts only sequence under a PACED arrival process: the run
+uses ``mean_interval_ms=200`` over 30 requests so requests are still
+arriving when the circuit recovers (a saturating interval-0 stream
+routes everything before the probe can heal the lane, and the fatal
+act never fires — which is exactly what the 8-video sweep row does,
+exercising act 1 alone).
+
+Then asserts the containment contract: the run terminates cleanly at
+its target; **every request terminates exactly once** (completed +
+dead-lettered + shed == the request count — rerouted work counts once,
+duplicate arrivals hit the dedup ledger, zero stranded in the window);
+the selector never fed the open/evicted lane; and ``parse_utils
+--check`` is green, including the Net: footing invariants
+(frames_sent == frames_acked + resent_pending, per-class errors re-sum
+to the total, dedup drops pair 1:1 with duplicate arrivals).
+
+Exit 0 = containment holds. ~60 s with a warm XLA compile cache (two
+processes each compile the reduced model); no dataset, no native
+decoder required (synthetic video ids).
+"""
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_"
+                                 "device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the spawned peer re-imports the config's model classes from a fresh
+# interpreter, so the repo root must reach it through the environment
+os.environ["PYTHONPATH"] = (REPO + os.pathsep
+                            + os.environ.get("PYTHONPATH", "")).rstrip(
+                                os.pathsep)
+
+CONFIG = "configs/rnb-netedge-chaos.json"
+NUM_VIDEOS = 30
+MEAN_INTERVAL_MS = 200  # paced arrivals — see the act sequencing above
+NET_LANE = "0"  # the edge's single lane on its dedicated health board
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rnb_tpu.benchmark import run_benchmark
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import parse_utils
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="rnb-netchaos-") as tmp:
+        res = run_benchmark(os.path.join(REPO, CONFIG),
+                            mean_interval_ms=MEAN_INTERVAL_MS,
+                            num_videos=NUM_VIDEOS,
+                            queue_size=64, log_base=tmp,
+                            print_progress=False, seed=17)
+        if res.termination_flag != 0:
+            failures.append("netchaos run terminated with flag %d"
+                            % res.termination_flag)
+        problems, parse_failed = parse_utils.check_job_detail(
+            res.log_dir)
+        for problem in problems:
+            failures.append("--check (%s): %s"
+                            % ("parse" if parse_failed else "invariant",
+                               problem))
+
+        print("netchaos arm: %d completed / %d dead-lettered / %d "
+              "shed of %d requests; wire %d sent = %d acked + %d "
+              "pending, %d reconnect(s), %d resend(s), %d remote / "
+              "%d local; errors %d (refused %d, reset %d, timeout %d, "
+              "partial %d, corrupt %d); %d eviction(s), "
+              "open-before-timeout=%d"
+              % (res.num_completed, res.num_failed, res.num_shed,
+                 NUM_VIDEOS, res.net_frames_sent, res.net_frames_acked,
+                 res.net_resent_pending, res.net_reconnects,
+                 res.net_resends, res.net_remote, res.net_local,
+                 res.net_err_total, res.net_err_refused,
+                 res.net_err_reset, res.net_err_timeout,
+                 res.net_err_partial_frame, res.net_err_corrupt,
+                 res.health_evictions, res.net_open_before_timeout))
+
+        # every request terminates exactly once — across a reset, a
+        # wedge, a peer death, reroutes and resends, the arithmetic
+        # must still foot with zero strands and zero double counts
+        terminated = res.num_completed + res.num_failed + res.num_shed
+        if terminated != NUM_VIDEOS:
+            failures.append(
+                "%d of %d requests terminated (completed+failed+shed) "
+                "— every request must terminate exactly once"
+                % (terminated, NUM_VIDEOS))
+        if res.net_window_stranded != 0:
+            failures.append("%d request(s) stranded in the resend "
+                            "window at teardown"
+                            % res.net_window_stranded)
+        if res.net_dedup_drops != res.net_dup_arrivals:
+            failures.append(
+                "dedup ledger out of balance: %d drops vs %d "
+                "duplicate arrivals" % (res.net_dedup_drops,
+                                        res.net_dup_arrivals))
+        # act 1: the non-fatal reset was survived by a reconnect
+        if res.net_err_reset < 1:
+            failures.append("the injected net_reset was never "
+                            "classified (err_reset=0)")
+        if res.net_reconnects < 1:
+            failures.append("the sender never reconnected after the "
+                            "mid-stream reset (reconnects=0)")
+        # act 2: the circuit opened on beat staleness BEFORE the io
+        # timeout classified the wedge — liveness must outrun detection
+        if res.net_err_timeout < 1:
+            failures.append("the injected net_timeout stall was never "
+                            "classified (err_timeout=0)")
+        if res.net_open_before_timeout != 1:
+            failures.append(
+                "the circuit did not open before the io timeout "
+                "detected the stall (open_before_timeout=%d) — the "
+                "beat-staleness walk must beat the 2.5 s classifier"
+                % res.net_open_before_timeout)
+        # act 3: the peer death exhausted the redial budget into
+        # refused dials and an eviction, with a legal transition log
+        if res.net_err_refused < 1:
+            failures.append("no refused dials were classified after "
+                            "the fatal peer kill (err_refused=0)")
+        if res.health_evictions != 1:
+            failures.append("expected exactly 1 lane eviction, got %d"
+                            % res.health_evictions)
+        lane = res.health_lane_detail.get(NET_LANE, {})
+        if lane.get("state") != "evicted":
+            failures.append("net lane %s should be evicted, detail "
+                            "says %r" % (NET_LANE, lane.get("state")))
+        # the fallback carried the run home: work drained locally both
+        # while the circuit was open and after the eviction
+        if res.net_local < 1:
+            failures.append("no request ever drained to the "
+                            "in-process fallback (local=0)")
+        if res.net_remote < 1:
+            failures.append("no request was ever served remotely "
+                            "(remote=0) — the wire never carried work")
+        # the dispatcher never fed the lane once the circuit was open
+        if res.health_routes_after_open != 0:
+            failures.append(
+                "dispatcher routed %d request(s) to the open/evicted "
+                "net lane" % res.health_routes_after_open)
+        # the wire ledger foots (the same identity --check re-derives
+        # offline from the Net: meta line)
+        if res.net_frames_sent != res.net_frames_acked \
+                + res.net_resent_pending:
+            failures.append(
+                "wire ledger does not foot: %d sent != %d acked + %d "
+                "pending" % (res.net_frames_sent, res.net_frames_acked,
+                             res.net_resent_pending))
+
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("OK — network chaos contained: reset survived by %d "
+          "reconnect(s), the circuit opened before the io timeout saw "
+          "the wedge, the dead peer was evicted after %d refused "
+          "dial(s), all %d requests terminated exactly once "
+          "(%d remote / %d local), --check green"
+          % (res.net_reconnects, res.net_err_refused, NUM_VIDEOS,
+             res.net_remote, res.net_local))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

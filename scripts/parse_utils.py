@@ -213,6 +213,26 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["stacks_" + key] = int(val)
+        elif line.startswith("Net errors:"):
+            # "Net errors: total=T refused=R reset=S timeout=O
+            #  partial_frame=P corrupt=C" — per-class network fault
+            # counts off the PR 1 taxonomy (rnb_tpu.netedge); must be
+            # matched before the "Net:" prefix below; netedge-enabled
+            # runs only; --check re-sums the classes to total
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["net_err_" + key] = int(val)
+        elif line.startswith("Net:"):
+            # "Net: frames_sent=A frames_acked=B resent_pending=C
+            #  resends=D beats=E reconnects=F remote=G local=H
+            #  dedup_drops=I dup_arrivals=J wire_bytes=K frame_bytes=L
+            #  window_stranded=M open_before_timeout=N" — cross-host
+            # ingest edge ledger (rnb_tpu.netedge), netedge-enabled
+            # runs only; --check holds the send/ack/resend and dedup
+            # identities and the zero-strand invariant
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["net_" + key] = int(val)
         elif line.startswith("Phases:"):
             # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
             # latency attribution over steady-state completions,
@@ -1033,6 +1053,12 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # ways, the stacks.folded counts must re-sum to the Stacks: total,
     # and the sampler's tick count must track sample_hz x wall
     problems.extend(_check_operator(job_dir, meta))
+    # cross-host ingest edge (rnb_tpu.netedge): the send/ack/resend
+    # ledger must foot at teardown, per-class error counts must re-sum
+    # to the total, every duplicate arrival must have been dropped by
+    # the dedup ledger (exactly-once), and a target-reached run may
+    # strand nothing in the resend window
+    problems.extend(_check_netedge(meta))
     return problems, parse_failed
 
 
@@ -1122,6 +1148,75 @@ def _check_health(meta: Dict[str, object],
                 "only %d of %d requests terminated (completed + "
                 "failed + shed) on a target-reached chaos run — the "
                 "rest are stranded" % (terminated, meta["videos"]))
+    return problems
+
+
+def _check_netedge(meta: Dict[str, object]) -> List[str]:
+    """Cross-host ingest edge invariants (rnb_tpu.netedge): the 'Net:'
+    and 'Net errors:' ledgers must be internally consistent — sends
+    foot against acks plus the unacked remainder, error classes re-sum
+    to the total, duplicates and dedup drops pair 1:1 (the exactly-
+    once guarantee made visible), and a target-reached run strands
+    nothing in the resend window."""
+    problems: List[str] = []
+    if "net_frames_sent" not in meta:
+        if "net_err_total" in meta:
+            problems.append("log-meta carries a 'Net errors:' line "
+                            "but no 'Net:' totals line")
+        return problems
+    if "net_err_total" not in meta:
+        problems.append("log-meta carries a 'Net:' line but no "
+                        "'Net errors:' line")
+        return problems
+    for key in ("net_frames_sent", "net_frames_acked",
+                "net_resent_pending", "net_resends", "net_beats",
+                "net_reconnects", "net_remote", "net_local",
+                "net_dedup_drops", "net_dup_arrivals",
+                "net_wire_bytes", "net_frame_bytes",
+                "net_window_stranded", "net_open_before_timeout",
+                "net_err_total", "net_err_refused", "net_err_reset",
+                "net_err_timeout", "net_err_partial_frame",
+                "net_err_corrupt"):
+        if meta.get(key, 0) < 0:
+            problems.append("negative %s" % key)
+    sent = meta.get("net_frames_sent", 0)
+    acked = meta.get("net_frames_acked", 0)
+    pending = meta.get("net_resent_pending", 0)
+    if sent != acked + pending:
+        problems.append(
+            "net_frames_sent=%d != net_frames_acked=%d + "
+            "net_resent_pending=%d — the send/ack ledger does not "
+            "foot at teardown" % (sent, acked, pending))
+    class_sum = sum(meta.get(k, 0) for k in
+                    ("net_err_refused", "net_err_reset",
+                     "net_err_timeout", "net_err_partial_frame",
+                     "net_err_corrupt"))
+    if class_sum != meta.get("net_err_total", 0):
+        problems.append(
+            "per-class net error counts sum to %d but the 'Net "
+            "errors:' line says total=%d — a fault class escaped "
+            "classification" % (class_sum, meta.get("net_err_total",
+                                                    0)))
+    if meta.get("net_dedup_drops", 0) != meta.get("net_dup_arrivals",
+                                                  0):
+        problems.append(
+            "net_dedup_drops=%d != net_dup_arrivals=%d — a duplicate "
+            "arrival escaped the receiver-side dedup ledger (exactly-"
+            "once violated)" % (meta.get("net_dedup_drops", 0),
+                                meta.get("net_dup_arrivals", 0)))
+    if meta.get("net_frames_sent", 0) \
+            < meta.get("net_remote", 0):
+        problems.append(
+            "net_remote=%d exceeds net_frames_sent=%d — a remote "
+            "dispatch that never produced a REQ frame"
+            % (meta.get("net_remote", 0), meta.get("net_frames_sent",
+                                                   0)))
+    if meta.get("termination_flag") == 0 \
+            and meta.get("net_window_stranded", 0) != 0:
+        problems.append(
+            "net_window_stranded=%d on a target-reached run — "
+            "requests left in the resend window were neither "
+            "rerouted nor settled" % meta["net_window_stranded"])
     return problems
 
 

@@ -1,0 +1,16 @@
+"""Bad fixture: socket verbs with no configured timeout in sight
+(RNB-H009, socket face) — a silently dead peer blocks this thread
+forever instead of classifying as ``net_timeout``. The socket's
+timeout cannot ride the call like a queue wait's ``timeout=`` kwarg,
+so the function that blocks must be the one seen bounding it."""
+
+
+def serve_forever(lsock):
+    conn, _ = lsock.accept()            # RNB-H009: no settimeout
+    head = conn.recv(28)                # RNB-H009: no settimeout
+    return head
+
+
+def dial(sock, addr):
+    sock.connect(addr)                  # RNB-H009: no settimeout
+    return sock

@@ -1,0 +1,19 @@
+"""Good fixture: socket verbs with in-function timeout discipline
+(the RNB-H009 socket face stays quiet). Two sanctioned shapes: the
+configuring function ``settimeout``s the sockets it blocks on, and a
+leaf read helper ``gettimeout``-guards a socket it was handed (the
+``rnb_tpu.ops.wire.recv_exact`` idiom — refuse an unbounded socket
+rather than trust every caller)."""
+
+
+def serve_once(lsock, io_timeout_s):
+    lsock.settimeout(1.0)
+    conn, _ = lsock.accept()
+    conn.settimeout(io_timeout_s)
+    return conn.recv(28)
+
+
+def recv_exact(sock, n):
+    if sock.gettimeout() is None:
+        raise ValueError("socket needs a configured timeout")
+    return sock.recv(n)

@@ -1,101 +1,91 @@
-"""Two-process jax.distributed smoke over the CPU backend.
+"""Two-process cross-host smoke over the netedge ingest transport.
 
-Proves the multi-host path end-to-end on one machine: two controller
-processes initialize through rnb_tpu.parallel.distributed's env
-contract (the same one rnb_tpu.benchmark honors at launch), see each
-other's devices, build ONE global mesh, and run a cross-process psum —
-the DCN-scale analog of SURVEY.md §2.4's comm backend.
+The original smoke here ran a jax.distributed psum between two
+controller processes — and skipped every round on this image because
+the CPU-backend jaxlib ships no multi-process collectives. ROADMAP
+item 2's transport layer now exists in-repo (rnb_tpu.netedge), and its
+two-process harness needs no collectives at all: a REAL second python
+process builds step 0 of the same config, serves it over the
+length-prefixed checksummed TCP frame protocol (rnb_tpu.ops.wire), and
+the launcher's receiver injects the responses straight into the step-0
+output queue. That is the cross-host seam this file proves end-to-end
+on one machine — requests leave the process, rows come back, nothing
+is lost and nothing is dispatched twice.
 """
 
+import json
 import os
-import socket
-import subprocess
 import sys
-
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
-#: the error the CPU backend raises when this jaxlib build ships no
-#: multi-process collective support — an environment capability gap,
-#: not a code regression, so the test skips with a tracking note
-#: instead of failing every round on such images (tracking: re-enable
-#: rides ROADMAP item 2, the disaggregated front-end, whose transport
-#: work needs a collectives-capable build anyway)
-_NO_MULTIPROC_CPU = ("Multiprocess computations aren't implemented "
-                     "on the CPU backend")
-
-_WORKER = r"""
-import sys
-
-import numpy as np
-
-from rnb_tpu.parallel.distributed import (global_mesh, is_primary,
-                                          maybe_initialize, process_count)
-
-assert maybe_initialize() is True
-
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-assert jax.process_count() == 2, jax.process_count()
-n = len(jax.devices())
-assert n == 4, "expected 2 procs x 2 virtual devices, saw %d" % n
-
-mesh = global_mesh(axis_names=("dp",))
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
-
-x = jax.jit(lambda: jnp.arange(n, dtype=jnp.float32),
-            out_shardings=NamedSharding(mesh, P("dp")))()
-psum = jax.jit(shard_map(lambda a: jax.lax.psum(a, "dp"), mesh=mesh,
-                         in_specs=P("dp"), out_specs=P()))
-total = float(np.asarray(psum(x)).sum())
-assert total == float(np.arange(n).sum()), total
-if is_primary():
-    print("DIST-OK total=%s" % total)
-sys.stdout.flush()
-"""
+from rnb_tpu.benchmark import run_benchmark  # noqa: E402
+from rnb_tpu.control import TerminationFlag  # noqa: E402
 
 
-def test_two_process_distributed_psum(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+def _netedge_config():
+    return {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "netedge": {
+            "enabled": True,
+            "spawn": True,
+            "beat_ms": 100,
+            "io_timeout_ms": 2000,
+            "max_retries": 3,
+            "backoff_ms": 20,
+            "resend_window": 4,
+        },
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 8},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [0], "in_queue": 0}]},
+        ],
+    }
 
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update({
-            "RNB_TPU_COORDINATOR": "127.0.0.1:%d" % port,
-            "RNB_TPU_NUM_PROCESSES": "2",
-            "RNB_TPU_PROCESS_ID": str(pid),
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-            "PYTHONPATH": REPO,
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER], env=env, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=180)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    if any(rc != 0 and _NO_MULTIPROC_CPU in err
-           for rc, _out, err in outs):
-        pytest.skip("this jaxlib's CPU backend has no multi-process "
-                    "collectives (%r) — environment capability, not "
-                    "a regression; re-enable when the image ships a "
-                    "collectives-capable build (ROADMAP item 2)"
-                    % _NO_MULTIPROC_CPU)
-    for rc, out, err in outs:
-        assert rc == 0, err[-2000:]
-    assert any("DIST-OK" in out for _rc, out, _err in outs), outs
+
+def test_two_process_netedge_transport(tmp_path, monkeypatch):
+    # the spawned peer re-imports this config's model classes, so it
+    # needs the repo root on ITS sys.path too (spawn_peer inherits env)
+    monkeypatch.setenv("PYTHONPATH", REPO)
+    path = os.path.join(str(tmp_path), "netedge.json")
+    with open(path, "w") as f:
+        json.dump(_netedge_config(), f)
+    res = run_benchmark(path, mean_interval_ms=0, num_videos=12,
+                        queue_size=50, log_base=str(tmp_path / "logs"),
+                        print_progress=False, seed=3)
+    assert res.termination_flag \
+        == TerminationFlag.TARGET_NUM_VIDEOS_REACHED
+    # every request crossed the process boundary: the peer served all
+    # of them, none fell back to the in-process path, and the wire
+    # ledger foots exactly (sent == acked, nothing left pending)
+    assert res.net_remote == 12
+    assert res.net_local == 0
+    assert res.net_frames_sent == 12
+    assert res.net_frames_acked == 12
+    assert res.net_resent_pending == 0
+    assert res.net_window_stranded == 0
+    assert res.net_wire_bytes > 0
+    assert res.net_frame_bytes > 0
+    # exactly-once on a clean wire: no duplicates arrived, none were
+    # dropped, no errors were classified
+    assert res.net_dedup_drops == 0
+    assert res.net_dup_arrivals == 0
+    assert res.net_err_total == 0
+    assert res.num_failed == 0 and res.num_shed == 0
+    # the offline invariants agree (send/ack footing, error re-sum,
+    # dedup pairing, zero strands on a target-reached run)
+    import parse_utils
+    assert parse_utils.check_job(res.log_dir) == []
+    # timing tables carry the peer's stamps: the remote stage's
+    # runner0/inference0 instants rode the wire home inside each
+    # request's TimeCard
+    reports = [f for f in os.listdir(res.log_dir) if "group" in f]
+    assert len(reports) == 1
+    with open(os.path.join(res.log_dir, reports[0])) as f:
+        header = f.readline().split()
+    assert "runner0_start" in header
+    assert "inference0_finish" in header

@@ -1,0 +1,404 @@
+"""The cross-host ingest edge (rnb_tpu.netedge + rnb_tpu.ops.wire).
+
+Unit coverage for the frame codec and its fault classification, the
+seeded reconnect backoff, both dedup ledgers (exactly-once under ack
+loss), the health-board binding, receive-boundary deadline shedding —
+plus a fault-injected two-process end-to-end run held to ``parse_utils
+--check`` and the netedge-off byte-stability contract.
+"""
+
+import json
+import os
+import queue
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from rnb_tpu.control import (FaultStats, InferenceCounter,  # noqa: E402
+                             TerminationState)
+from rnb_tpu.faults import (NetCorruptFrameError,  # noqa: E402
+                            NetPartialFrameError, NetRefusedError,
+                            NetResetError, NetTimeoutError,
+                            PermanentError, TransientError)
+from rnb_tpu.health import (DeadlineStats, HealthSettings,  # noqa: E402
+                            LaneHealthBoard, deadline_site)
+from rnb_tpu.netedge import (NET_LANE, BACKOFF_CAP_MS,  # noqa: E402
+                             JITTER_FRAC, NetEdgeClient,
+                             NetEdgeSettings, NetStats,
+                             backoff_schedule_ms, parse_addr)
+from rnb_tpu.ops import wire  # noqa: E402
+from rnb_tpu.stage import PaddedBatch  # noqa: E402
+from rnb_tpu.telemetry import TimeCard  # noqa: E402
+
+
+# -- frame codec ------------------------------------------------------
+
+def _pair():
+    """A socketpair with configured timeouts — the wire layer REFUSES
+    an unbounded socket (a silent peer must surface as net_timeout,
+    never as a forever-blocked recv)."""
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_recv_refuses_an_unbounded_socket():
+    a, b = socket.socketpair()   # deliberately no settimeout
+    try:
+        with pytest.raises(ValueError, match="configured timeout"):
+            wire.read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_roundtrip_over_a_real_socket():
+    a, b = _pair()
+    try:
+        card = TimeCard(7)
+        card.record("enqueue_filename")
+        payload = wire.encode_req("video-7", card)
+        a.sendall(wire.encode_frame(wire.REQ, payload, seq=42,
+                                    deadline=123.5, depth=3))
+        ftype, flags, depth, seq, deadline, got = wire.read_frame(b)
+        assert (ftype, flags, depth, seq, deadline) \
+            == (wire.REQ, 0, 3, 42, 123.5)
+        path, card2 = wire.decode_req(got)
+        assert path == "video-7" and card2.id == 7
+        assert list(card2.timings) == ["enqueue_filename"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_corrupt_frame_classifies_and_carries_seq():
+    a, b = _pair()
+    try:
+        frame = bytearray(wire.encode_frame(wire.DATA, b"payload",
+                                            seq=9))
+        frame[-1] ^= 0xff   # flip a payload byte AFTER the crc stamp
+        a.sendall(bytes(frame))
+        with pytest.raises(NetCorruptFrameError) as exc_info:
+            wire.read_frame(b)
+        assert exc_info.value.seq == 9
+        # framing survived: the next frame on the same connection reads
+        a.sendall(wire.encode_frame(wire.BEAT, depth=1))
+        assert wire.read_frame(b)[0] == wire.BEAT
+    finally:
+        a.close()
+        b.close()
+
+
+def test_partial_frame_vs_reset_classification():
+    # EOF mid-frame -> partial; EOF at a frame boundary -> reset
+    a, b = _pair()
+    frame = wire.encode_frame(wire.DATA, b"x" * 64, seq=1)
+    a.sendall(frame[:len(frame) // 2])
+    a.close()
+    with pytest.raises(NetPartialFrameError):
+        wire.read_frame(b)
+    b.close()
+
+    a, b = _pair()
+    a.close()
+    with pytest.raises(NetResetError):
+        wire.read_frame(b)
+    b.close()
+
+
+def test_io_error_classification_taxonomy():
+    assert isinstance(wire.classify_io_error(socket.timeout()),
+                      NetTimeoutError)
+    assert isinstance(wire.classify_io_error(ConnectionRefusedError()),
+                      NetRefusedError)
+    assert isinstance(wire.classify_io_error(ConnectionResetError()),
+                      NetResetError)
+    assert isinstance(wire.classify_io_error(BrokenPipeError()),
+                      NetResetError)
+    assert wire.classify_io_error(ValueError()) is None
+    # the taxonomy split: only corruption is permanent
+    for cls in (NetRefusedError, NetResetError, NetTimeoutError,
+                NetPartialFrameError):
+        assert issubclass(cls, TransientError), cls
+    assert issubclass(NetCorruptFrameError, PermanentError)
+
+
+def test_data_codec_ships_valid_rows_and_repads():
+    rows = np.arange(2 * 3, dtype=np.float32).reshape(2, 3)
+    batch = PaddedBatch.from_rows(rows, 5)
+    card = TimeCard(3)
+    card.num_clips = 2
+    payload = wire.encode_data(batch, 3, card)
+    out, non_tensors, card2, row_bytes = wire.decode_data(payload)
+    assert row_bytes == rows.nbytes   # ONLY the valid rows crossed
+    assert non_tensors == 3 and card2.id == 3
+    assert out.valid == 2 and out.max_rows == 5
+    np.testing.assert_array_equal(np.asarray(out.data)[:2], rows)
+    assert not np.asarray(out.data)[2:].any()   # re-padded with zeros
+
+
+def test_data_codec_rejects_fused_emissions():
+    from rnb_tpu.telemetry import TimeCardList
+    batch = PaddedBatch.from_rows(np.zeros((1, 2), np.float32), 2)
+    cards = TimeCardList([TimeCard(0), TimeCard(1)])
+    with pytest.raises(ValueError, match="single-request"):
+        wire.encode_data(batch, None, cards)
+
+
+# -- reconnect backoff ------------------------------------------------
+
+def test_backoff_schedule_is_seeded_and_capped():
+    a = backoff_schedule_ms(50, 6, seed=17)
+    b = backoff_schedule_ms(50, 6, seed=17)
+    assert a == b                      # replayable byte-for-byte
+    assert a != backoff_schedule_ms(50, 6, seed=18)
+    assert len(a) == 6
+    for i, delay in enumerate(a):
+        base = min(50.0 * 2 ** i, BACKOFF_CAP_MS)
+        assert base <= delay <= base * (1 + JITTER_FRAC)
+    # exponential growth until the cap
+    assert a[0] < a[1] < a[2]
+
+
+def test_parse_addr():
+    assert parse_addr("127.0.0.1:80") == ("127.0.0.1", 80)
+    with pytest.raises(ValueError):
+        parse_addr("no-port")
+
+
+# -- client-side dedup / deadline / board binding ---------------------
+
+def _client(num_videos=4, health=None, deadline_stats=None):
+    settings = NetEdgeSettings(connect="127.0.0.1:1", beat_ms=20,
+                               io_timeout_ms=100, max_retries=1,
+                               backoff_ms=1, resend_window=4)
+    stats = NetStats()
+    board = LaneHealthBoard((NET_LANE,), health or HealthSettings())
+    client = NetEdgeClient(
+        settings, board=board, stats=stats, fault_plan=None,
+        fault_stats=FaultStats(), deadline_stats=deadline_stats,
+        counter=InferenceCounter(), num_videos=num_videos,
+        termination=TerminationState(), filename_queue=queue.Queue(),
+        local_queue=queue.Queue(), inject_queue=queue.Queue(),
+        num_markers=1, seed=11)
+    return client
+
+
+def _window_entry(client, seq, rid, deadline_s=None):
+    card = TimeCard(rid)
+    if deadline_s is not None:
+        card.deadline_s = deadline_s
+    frame = wire.encode_frame(wire.REQ,
+                              wire.encode_req("video-%d" % rid, card),
+                              seq=seq)
+    from rnb_tpu.netedge import _WindowEntry
+    client._window[seq] = _WindowEntry(seq, "video-%d" % rid, card,
+                                       frame)
+    client.board.note_enqueue(NET_LANE)
+    return card
+
+
+def _data_payload(rid, deadline_s=None):
+    rows = np.full((1, 2), float(rid), np.float32)
+    card = TimeCard(rid)
+    if deadline_s is not None:
+        card.deadline_s = deadline_s
+    return wire.encode_data(PaddedBatch.from_rows(rows, 2), rid, card)
+
+
+def test_resend_dedup_dispatches_exactly_once():
+    """Ack lost -> resend -> the response arrives twice; the second
+    copy hits the dedup ledger, never the inject queue."""
+    client = _client()
+    _window_entry(client, seq=1, rid=0)
+    payload = _data_payload(0)
+    client._on_data(1, payload)           # first arrival: dispatched
+    client._on_data(1, payload)           # resend's twin: dropped
+    assert client.inject_queue.qsize() == 1
+    snap = client.stats.snapshot()
+    assert snap["dup_arrivals"] == 1
+    assert snap["dedup_drops"] == 1
+    assert client._finalizing == 0        # drain gate fully released
+    # dispatched work completes (and counts) DOWNSTREAM — the edge
+    # itself disposes nothing on the success path
+    assert client.counter.value == 0
+
+
+def test_ack_then_data_settles_once():
+    client = _client()
+    _window_entry(client, seq=5, rid=2)
+    client._on_ack(5)
+    client._on_ack(5)                     # duplicate ack: counted once
+    assert client.stats.snapshot()["frames_acked"] == 1
+    client._on_data(5, _data_payload(2))
+    assert client.inject_queue.qsize() == 1
+    assert client.stats.snapshot()["dup_arrivals"] == 0
+
+
+def test_deadline_expiry_sheds_at_the_netedge_site():
+    """A response whose every constituent deadline has passed is shed
+    at the receive boundary — site 'netedge:deadline_expired' — and
+    still terminates exactly once (disposed, never injected)."""
+    deadline_stats = DeadlineStats()
+    client = _client(deadline_stats=deadline_stats)
+    past = time.time() - 10.0
+    _window_entry(client, seq=1, rid=0, deadline_s=past)
+    client._on_data(1, _data_payload(0, deadline_s=past))
+    assert client.inject_queue.qsize() == 0
+    site = deadline_site("netedge")
+    assert site == "netedge:deadline_expired"
+    assert deadline_stats.snapshot()["sites"] == {site: 1}
+    assert client.fault_stats.snapshot()["shed_sites"] == {site: 1}
+    assert client.counter.value == 1
+    # an unexpired response on the same run dispatches normally
+    future = time.time() + 60.0
+    _window_entry(client, seq=2, rid=1, deadline_s=future)
+    client._on_data(2, _data_payload(1, deadline_s=future))
+    assert client.inject_queue.qsize() == 1
+
+
+def test_beat_staleness_walks_the_board_to_open():
+    """In-flight work + a silent peer: the dispatcher's idle ticks
+    (route_filter consults, NEVER beat()) walk the lane
+    healthy -> suspect -> open on staleness alone."""
+    client = _client(health=HealthSettings(suspect_after_ms=30,
+                                           open_after_ms=80,
+                                           probe_interval_ms=60))
+    client.board.beat(NET_LANE)
+    _window_entry(client, seq=1, rid=0)   # in-flight, then... silence
+    assert client.board.state(NET_LANE) == "healthy"
+    deadline = time.monotonic() + 2.0
+    while client.board.state(NET_LANE) != "suspect" \
+            and time.monotonic() < deadline:
+        client._tick()
+        time.sleep(0.01)
+    assert client.board.state(NET_LANE) == "suspect"
+    while client.board.state(NET_LANE) != "open" \
+            and time.monotonic() < deadline:
+        client._tick()
+        time.sleep(0.01)
+    assert client.board.state(NET_LANE) == "open"
+    assert client.stats.snapshot()["open_before_timeout"] == 0  # pre-finalize
+    # a settle while open is still honored (the response dispatches)
+    client._on_data(1, _data_payload(0))
+    assert client.inject_queue.qsize() == 1
+
+
+def test_dead_letter_fails_the_request_exactly_once():
+    client = _client()
+    card = _window_entry(client, seq=3, rid=1)
+    client._dead_letter(3)
+    assert card.status == "failed"
+    assert card.failure_reason == "net_corrupt"
+    assert client.fault_stats.snapshot()["failure_reasons"] \
+        == {"net_corrupt": 1}
+    assert client.counter.value == 1
+    client._dead_letter(3)                # idempotent on unknown seq
+    assert client.counter.value == 1
+
+
+# -- two-process end-to-end with injected faults ----------------------
+
+def _netedge_config(extra_root=None, netedge=None):
+    cfg = {
+        "video_path_iterator":
+            "tests.pipeline_helpers.CountingPathIterator",
+        "netedge": dict({
+            "enabled": True, "spawn": True, "beat_ms": 100,
+            "io_timeout_ms": 2000, "max_retries": 3,
+            "backoff_ms": 20, "resend_window": 4,
+        }, **(netedge or {})),
+        "pipeline": [
+            {"model": "tests.pipeline_helpers.TinyLoader",
+             "queue_groups": [{"devices": [0], "out_queues": [0]}],
+             "num_shared_tensors": 8},
+            {"model": "tests.pipeline_helpers.TinySink",
+             "queue_groups": [{"devices": [0], "in_queue": 0}]},
+        ],
+    }
+    cfg.update(extra_root or {})
+    return cfg
+
+
+def test_two_process_e2e_with_injected_net_faults(tmp_path,
+                                                  monkeypatch):
+    """net_corrupt dead-letters exactly one request on the wire;
+    net_timeout wedges the peer briefly (beats pause, the io timeout
+    classifies it); every request still terminates exactly once and
+    the offline --check invariants hold."""
+    monkeypatch.setenv("PYTHONPATH", REPO)
+    from rnb_tpu.benchmark import run_benchmark
+    cfg = _netedge_config(extra_root={"fault_plan": {
+        "seed": 5,
+        "faults": [
+            {"kind": "net_corrupt", "request_ids": [3]},
+            {"kind": "net_timeout", "request_ids": [6], "ms": 2500},
+        ],
+    }})
+    path = os.path.join(str(tmp_path), "chaos.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    res = run_benchmark(path, mean_interval_ms=0, num_videos=10,
+                        queue_size=50, log_base=str(tmp_path / "logs"),
+                        print_progress=False, seed=5)
+    assert res.termination_flag == 0
+    assert res.net_err_corrupt == 1
+    assert res.num_failed == 1            # the corrupt frame's request
+    assert res.net_err_timeout >= 1       # the wedge was classified
+    assert res.net_window_stranded == 0
+    assert res.net_frames_sent \
+        == res.net_frames_acked + res.net_resent_pending
+    assert res.net_dedup_drops == res.net_dup_arrivals
+    import parse_utils
+    assert parse_utils.check_job(res.log_dir) == []
+
+
+def test_net_faults_without_netedge_are_rejected(tmp_path):
+    from rnb_tpu.benchmark import run_benchmark
+    cfg = _netedge_config(extra_root={
+        "netedge": {"enabled": False},
+        "fault_plan": {"seed": 1, "faults": [
+            {"kind": "net_reset", "request_ids": [0]}]},
+    })
+    path = os.path.join(str(tmp_path), "bad.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    with pytest.raises(ValueError, match="net"):
+        run_benchmark(path, mean_interval_ms=0, num_videos=2,
+                      queue_size=10, log_base=str(tmp_path / "logs"),
+                      print_progress=False)
+
+
+# -- netedge-off byte-stability ---------------------------------------
+
+def test_netedge_off_keeps_logs_byte_stable(tmp_path):
+    from rnb_tpu.benchmark import run_benchmark
+    cfg = _netedge_config()
+    del cfg["netedge"]
+    path = os.path.join(str(tmp_path), "plain.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    res = run_benchmark(path, mean_interval_ms=0, num_videos=8,
+                        queue_size=50, log_base=str(tmp_path / "logs"),
+                        print_progress=False)
+    assert res.termination_flag == 0
+    assert res.net_frames_sent == 0 and res.net_err_total == 0
+    with open(os.path.join(res.log_dir, "log-meta.txt")) as f:
+        meta_text = f.read()
+    assert "Net:" not in meta_text
+    assert "Net errors:" not in meta_text
+    # the stamp schema is exactly the pre-netedge set
+    tables = [n for n in os.listdir(res.log_dir) if "group" in n]
+    with open(os.path.join(res.log_dir, tables[0])) as f:
+        header = f.readline().split()
+    assert header == ["enqueue_filename", "runner0_start",
+                      "inference0_start", "inference0_finish",
+                      "runner1_start", "inference1_start",
+                      "inference1_finish", "device0", "device1"]

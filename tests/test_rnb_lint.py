@@ -159,6 +159,7 @@ HOTPATH_CASES = [
     ("bad_h007_alloc.py", "RNB-H007"),
     ("bad_h008_handoff.py", "RNB-H008"),
     ("bad_h009_block.py", "RNB-H009"),
+    ("bad_h009_socket.py", "RNB-H009"),
 ]
 
 
@@ -173,6 +174,15 @@ def test_good_h009_fixture_is_clean():
     # stay quiet on them — including on a wait-named leaf method
     from rnb_tpu.analysis.hotpath import check_file
     assert check_file(_fixture("good_h009_wait.py"),
+                      root=FIXTURES) == []
+
+
+def test_good_h009_socket_fixture_is_clean():
+    # the socket face of RNB-H009: settimeout-ing the sockets you
+    # block on, or gettimeout-guarding a handed-in one (the
+    # wire.recv_exact idiom), are the sanctioned shapes
+    from rnb_tpu.analysis.hotpath import check_file
+    assert check_file(_fixture("good_h009_socket.py"),
                       root=FIXTURES) == []
 
 
@@ -406,6 +416,8 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Whatif: stages=%d\\n" % wi)\n'
                      'f.write("Operator: scrapes=%d\\n" % op)\n'
                      'f.write("Stacks: samples=%d\\n" % st)\n'
+                     'f.write("Net: frames_sent=%d\\n" % nt)\n'
+                     'f.write("Net errors: total=%d\\n" % ne)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))
@@ -469,7 +481,14 @@ REPO_BENCH_LIKE = (
         'f.write("Operator: scrapes=%d actions=%d denied=%d '
         'errors=%d\\n" % op)\n'
         'f.write("Stacks: samples=%d threads=%d folded=%d '
-        'total=%d\\n" % st)\n')
+        'total=%d\\n" % st)\n'
+        'f.write("Net: frames_sent=%d frames_acked=%d '
+        'resent_pending=%d resends=%d beats=%d reconnects=%d '
+        'remote=%d local=%d dedup_drops=%d dup_arrivals=%d '
+        'wire_bytes=%d frame_bytes=%d window_stranded=%d '
+        'open_before_timeout=%d\\n" % nt)\n'
+        'f.write("Net errors: total=%d refused=%d reset=%d '
+        'timeout=%d partial_frame=%d corrupt=%d\\n" % ne)\n')
 
 
 def test_benchmark_result_counter_drift_triggers_t006(tmp_path):
@@ -541,6 +560,31 @@ def test_operator_stacks_counter_drift_triggers_t006(tmp_path):
     anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
     assert "operator_bogus_gets" in anchors
     assert "stacks_bogus_ticks" in anchors
+
+
+def test_net_counter_drift_triggers_t006(tmp_path):
+    """The RNB-T006 family covers the cross-host ingest lines: the
+    good fixture (REPO_BENCH_LIKE, which writes the full Net:/Net
+    errors: counter sets) is clean — which is also the reverse
+    direction, since every net_* BenchmarkResult field must map to a
+    written counter for that assert to hold — and a bogus counter on
+    either line surfaces as exactly its drifted field."""
+    from rnb_tpu.analysis.schema import check_benchmark_result
+    good = tmp_path / "good_bench_like.py"
+    good.write_text(REPO_BENCH_LIKE)
+    assert check_benchmark_result(str(good), root=str(tmp_path)) == []
+    bad = tmp_path / "bad_bench_like.py"
+    bad.write_text(REPO_BENCH_LIKE
+                   .replace('open_before_timeout=%d\\n',
+                            'open_before_timeout=%d bogus_frames=%d'
+                            '\\n')
+                   .replace('partial_frame=%d corrupt=%d\\n',
+                            'partial_frame=%d corrupt=%d '
+                            'bogus_class=%d\\n'))
+    findings = check_benchmark_result(str(bad), root=str(tmp_path))
+    anchors = {f.anchor for f in findings if f.rule == "RNB-T006"}
+    assert "net_bogus_frames" in anchors
+    assert "net_err_bogus_class" in anchors
 
 
 def test_schema_checker_clean_on_repo():
