@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: lint test native stamps trace ragged multichip chaos netchaos \
-	metrics dct devobs benchdiff explain operator
+	metrics dct devobs benchdiff explain operator pages
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -120,6 +120,17 @@ explain:
 # operator-off arm proving byte-stable logs.
 operator:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/operator_demo.py
+
+# Paged-memory gate (README "Paged memory"): bit-parity of paged
+# clip-cache hits and feature-page hits against the uncached forward
+# through real reduced stages, then a same-seed Zipf A/B (blob-cache
+# arm vs paged + feature-pages arm) asserting zero host memcpy bytes
+# on the hit path (gather rows == clip-cache hit rows), feature pages
+# serving repeat traffic, zero-transfer emissions counted, the Pages:
+# ledger footing (allocs == frees + live) and parse_utils --check
+# green on both arms.
+pages:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/pages_demo.py
 
 native:
 	$(MAKE) -C native

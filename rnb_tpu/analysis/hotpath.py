@@ -56,6 +56,17 @@ Rules
   liveness (termination flag, pool failure, deadline) each lap, or
   baseline the site with the justification for why it cannot hang
   (e.g. a Barrier carrying a construction-time timeout).
+* ``RNB-H010`` device-alloc-per-emission: a pool/bucket-shaped
+  DEVICE allocation (``jnp.zeros``/``jnp.empty``/``jnp.ones`` of a
+  stage-declared shape, or a ``device_put`` whose payload expression
+  derives from one) in a hot function outside the page allocator —
+  the device twin of RNB-H007. A fresh pool-shaped device array per
+  emission fragments HBM and defeats the single-slab page allocator
+  (rnb_tpu.pager) that exists to own exactly these bytes; allocate
+  once at stage init (an arena, a preallocated zero pool) and reuse,
+  or baseline a deliberate staged fallback with its justification.
+  ``rnb_tpu/pager.py`` itself is exempt: its arena slab is the one
+  legal pool-shaped device allocation.
 * ``RNB-H008`` host-materialization-on-device-edge: a host
   materialization call (``device_get``, ``np.asarray``/``np.array``,
   ``.copy_to_host_async``, ``.tolist``) inside a device-resident
@@ -311,6 +322,36 @@ def _bucket_alloc_kind(node: ast.Call) -> Optional[str]:
     return None
 
 
+#: receivers recognized as the jax.numpy module (RNB-H010)
+_JNP_NAMES = {"jnp"}
+
+#: the one module whose pool-shaped device allocation IS the design —
+#: the page allocator's arena slab (rnb_tpu.pager); everything else
+#: must draw from it or preallocate at stage init
+_H010_EXEMPT_BASENAMES = {"pager.py"}
+
+
+def _device_alloc_kind(node: ast.Call) -> Optional[str]:
+    """Classify one call as a pool/bucket-shaped DEVICE allocation
+    (RNB-H010), or None: a jnp zeros/empty/ones whose shape comes
+    from a stage-declared shape helper, or a device_put whose payload
+    expression derives from one (``device_put(np.zeros(
+    self._batch_shape(n)))`` is the canonical spelling)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) \
+            and f.attr in ("empty", "zeros", "ones") \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id in _JNP_NAMES and node.args:
+        if _attr_chain_has(node.args[0], _BATCH_SHAPE_HELPERS):
+            return "jnp.%s() of a stage-declared shape" % f.attr
+    if isinstance(f, ast.Attribute) and f.attr == "device_put" \
+            and node.args:
+        if any(_attr_chain_has(a, _BATCH_SHAPE_HELPERS)
+               for a in node.args):
+            return "device_put() of a stage-declared shape"
+    return None
+
+
 def _lint_hot_body(rel: str, qual: str, node,
                    findings: List[Finding]) -> None:
     loop_spans: List[Tuple[int, int]] = []
@@ -354,6 +395,17 @@ def _lint_hot_body(rel: str, qual: str, node,
                     "staging slot (rnb_tpu.staging) instead, or "
                     "baseline the copy fallback with its justification"
                     % alloc))
+            if os.path.basename(rel) not in _H010_EXEMPT_BASENAMES:
+                dev_alloc = _device_alloc_kind(sub)
+                if dev_alloc is not None:
+                    findings.append(Finding(
+                        "RNB-H010", rel, sub.lineno, qual,
+                        "%s on a hot path — a fresh pool-shaped device "
+                        "array per emission fragments HBM; draw from "
+                        "the page allocator (rnb_tpu.pager) or a "
+                        "stage-init preallocation, or baseline the "
+                        "deliberate fallback with its justification"
+                        % dev_alloc))
 
 
 #: attribute names whose NO-ARGUMENT call blocks until someone else

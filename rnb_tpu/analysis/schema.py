@@ -34,7 +34,9 @@ Rules
 * ``RNB-T007`` unregistered-content-stamp: an attribute stamped onto a
   TimeCard (``time_card.x = ...``) that is neither a core TimeCard
   attribute nor declared in ``CONTENT_STAMPS`` — it would silently
-  fail to survive fork/merge.
+  fail to survive fork/merge. Attributes in ``TRANSIENT_STAMPS`` are
+  also accepted: those are DECLARED single-owner carriers (live page
+  pins, insert obligations) that must NOT be copied onto a fork.
 * ``RNB-T008`` unregistered-trace-event: a ``trace.span`` /
   ``trace.instant`` / ``trace.counter`` / ``trace.name`` site emits an
   event name ``TRACE_EVENT_REGISTRY`` does not declare (the reverse —
@@ -60,7 +62,7 @@ from rnb_tpu.analysis.findings import (Finding, package_py_files,
 from rnb_tpu.telemetry import (CONTENT_STAMPS, META_LINE_REGISTRY,
                                METRIC_REGISTRY, STAMP_REGISTRY,
                                TABLE_TRAILER_REGISTRY,
-                               TRACE_EVENT_REGISTRY)
+                               TRACE_EVENT_REGISTRY, TRANSIENT_STAMPS)
 
 #: core TimeCard attributes (assignments to these are state, not
 #: content stamps)
@@ -375,7 +377,7 @@ def check_stamps(py_paths: Sequence[str], parse_utils_src: str,
 def check_content_stamps(py_paths: Sequence[str], root: str = ".",
                          content=CONTENT_STAMPS) -> List[Finding]:
     findings: List[Finding] = []
-    allowed = TIMECARD_ATTRS | set(content)
+    allowed = TIMECARD_ATTRS | set(content) | set(TRANSIENT_STAMPS)
     for rel, line, attr in extract_content_stamps(py_paths, root):
         if attr not in allowed:
             findings.append(Finding(

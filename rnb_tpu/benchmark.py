@@ -166,6 +166,14 @@ class BenchmarkResult:
     ragged_rows: int = 0
     ragged_pad_rows_eliminated: int = 0
     ragged_cache_hit_rows: int = 0
+    #: paged device-memory accounting (rnb_tpu.pager, root `pager`
+    #: config key) — the `Pages:` meta line verbatim: page
+    #: alloc/free/live occupancy, gather dispatches split by plane
+    #: (clip arena vs feature arena), feature-cache
+    #: lookup/hit/insert/evict counters, and bypassed_batches =
+    #: emissions that shipped ZERO host->device bytes because every
+    #: row gathered from pages. Empty without the key.
+    pages: Dict[str, int] = field(default_factory=dict)
     #: per-step jit-entry signature accounting
     #: (rnb_tpu.compilestats): {step: {warmup, steady_new,
     #: steady_calls}} — steady_new > 0 means a mid-run recompile; a
@@ -480,6 +488,17 @@ def run_benchmark(config_path: str,
                   "bucketed and no Ragged: telemetry will be emitted",
                   file=sys.stderr)
 
+    # paged device memory (root 'pager' config key, rnb_tpu.pager):
+    # ONE page allocator per job — executors hand it to every
+    # SUPPORTS_PAGER stage before the start barrier (the loader's
+    # clip cache switches to page tables, the consuming stage
+    # attaches the feature-page arena). Absent => None, byte-stable
+    # logs, no arenas allocated.
+    from rnb_tpu.pager import Pager, PagerSettings
+    pager_settings = PagerSettings.from_config(config.pager)
+    pager = Pager(pager_settings) if pager_settings is not None \
+        else None
+
     # device-resident handoff (root 'handoff' key, rnb_tpu.handoff):
     # consumer executors apply the edge contract to every ring payload
     # take and account d2d vs host-hop moves; absent => the stage
@@ -725,6 +744,16 @@ def run_benchmark(config_path: str,
                 "health", netedge_board.snapshot,
                 counters=("transitions", "opens", "evictions",
                           "probes", "redispatches")))
+        if pager is not None:
+            metrics_registry.add_poll(metrics_mod.snapshot_poll(
+                "pages", pager.snapshot,
+                counters=("allocs", "frees", "alloc_fails", "gathers",
+                          "gather_rows", "feature_lookups",
+                          "feature_hits", "feature_inserts",
+                          "feature_evictions", "feature_gathers",
+                          "feature_gather_rows",
+                          "feature_bytes_saved"),
+                gauges=("live", "limbo", "bytes")))
         bridge = metrics_mod.SpanBridge(
             metrics_registry, forward=tracer,
             ring_events=(metrics_settings.ring_events
@@ -884,6 +913,7 @@ def run_benchmark(config_path: str,
                     autotune=(autotune_settings if step.autotune
                               else None),
                     autotune_sink=autotune_sink,
+                    pager=pager,
                     compile_sink=compile_sink,
                     pad_sink=pad_sink,
                     ragged_sink=ragged_sink,
@@ -1281,6 +1311,18 @@ def run_benchmark(config_path: str,
             total_time, devobs_mod.devices_used(config.raw))
         memory_summary = devobs_plane.memory_summary()
 
+    # paged-memory ledger (rnb_tpu.pager): every pipeline thread
+    # joined, so live/limbo occupancy is settled and the teardown
+    # invariant (allocs == frees + live-held pages) is checkable from
+    # the line alone; bypassed_batches rides along from the staging
+    # plane (the zero-transfer emissions only the pager can produce)
+    pages_summary = None
+    if pager is not None:
+        pages_summary = pager.snapshot()
+        pages_summary["bypassed_batches"] = int(
+            staging_stats.get("bypassed_batches", 0)
+            if staging_stats else 0)
+
     faults = fault_stats.snapshot()
     num_failed = faults["num_failed"]
     num_shed = faults["num_shed"]
@@ -1334,6 +1376,37 @@ def run_benchmark(config_path: str,
                        staging_stats["staged_batches"],
                        staging_stats["copied_batches"],
                        staging_stats["reallocs"]))
+        if pages_summary is not None:
+            # only pager-enabled runs carry the line, keeping pager-off
+            # logs (including the Staging: line above) byte-stable with
+            # the earlier schema; --check holds allocs == frees + live
+            # at teardown, feature_hits <= feature_lookups, and
+            # gather_rows <= the ragged cache_hit_rows it serves
+            f.write("Pages: arenas=%d pages=%d page_rows=%d live=%d "
+                    "limbo=%d bytes=%d allocs=%d frees=%d "
+                    "alloc_fails=%d gathers=%d gather_rows=%d "
+                    "feature_lookups=%d feature_hits=%d "
+                    "feature_inserts=%d feature_evictions=%d "
+                    "feature_gathers=%d feature_gather_rows=%d "
+                    "feature_bytes_saved=%d feature_entries=%d "
+                    "bypassed_batches=%d\n"
+                    % (pages_summary["arenas"], pages_summary["pages"],
+                       pages_summary["page_rows"],
+                       pages_summary["live"], pages_summary["limbo"],
+                       pages_summary["bytes"],
+                       pages_summary["allocs"], pages_summary["frees"],
+                       pages_summary["alloc_fails"],
+                       pages_summary["gathers"],
+                       pages_summary["gather_rows"],
+                       pages_summary["feature_lookups"],
+                       pages_summary["feature_hits"],
+                       pages_summary["feature_inserts"],
+                       pages_summary["feature_evictions"],
+                       pages_summary["feature_gathers"],
+                       pages_summary["feature_gather_rows"],
+                       pages_summary["feature_bytes_saved"],
+                       pages_summary["feature_entries"],
+                       pages_summary["bypassed_batches"]))
         if autotune_stats is not None:
             # only autotune-enabled runs carry the lines, keeping
             # static-batching logs byte-stable with the earlier schema
@@ -1632,6 +1705,18 @@ def run_benchmark(config_path: str,
                  staging_stats["slot_bytes"] / (1 << 20),
                  staging_stats["acquire_waits"],
                  staging_stats["reallocs"]))
+    if pages_summary is not None and print_progress:
+        print("Pages: %d/%d pages live (%.1f MiB slab), %d gathers "
+              "(%d rows), feature %d/%d hits, %d emission(s) with "
+              "zero transfer bytes"
+              % (pages_summary["live"], pages_summary["pages"],
+                 pages_summary["bytes"] / (1 << 20),
+                 pages_summary["gathers"] + pages_summary["feature_gathers"],
+                 pages_summary["gather_rows"]
+                 + pages_summary["feature_gather_rows"],
+                 pages_summary["feature_hits"],
+                 pages_summary["feature_lookups"],
+                 pages_summary["bypassed_batches"]))
     if autotune_stats is not None and print_progress:
         print("Autotune: %d decision(s) (%d immediate / %d held), "
               "%d emission(s), buckets %s"
@@ -1848,6 +1933,7 @@ def run_benchmark(config_path: str,
             ragged_stats["pad_rows_eliminated"] if ragged_stats else 0),
         ragged_cache_hit_rows=(ragged_stats["cache_hit_rows"]
                                if ragged_stats else 0),
+        pages=dict(pages_summary) if pages_summary else {},
         compile_signatures=compile_stats,
         warmup_s=warmup_stats,
         handoff_edges=handoff_stats["edges"] if handoff_stats else 0,

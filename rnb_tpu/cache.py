@@ -94,6 +94,20 @@ class CacheEntry:
         self.nbytes = int(nbytes)
 
 
+class PagedEntry:
+    """One paged cache entry: a page *reference list* into the clip
+    arena's device slab (rnb_tpu.pager) instead of a contiguous blob —
+    any free pages serve any entry (no fragmentation, no oversize
+    skip) and eviction frees pages, not bytes."""
+
+    __slots__ = ("pages", "valid", "nbytes")
+
+    def __init__(self, pages: Tuple[int, ...], valid: int, nbytes: int):
+        self.pages = pages
+        self.valid = int(valid)
+        self.nbytes = int(nbytes)
+
+
 class ClipCache:
     """Bounded, byte-accounted LRU of device-resident clip batches."""
 
@@ -114,6 +128,93 @@ class ClipCache:
         self.num_evictions = 0
         self.num_coalesced = 0
         self.num_oversize = 0
+        #: paged mode (rnb_tpu.pager): entries become page reference
+        #: lists in this arena's slab; None = blob mode (the seed
+        #: semantics, byte-stable)
+        self._arena = None
+
+    def attach_arena(self, arena) -> None:
+        """Switch this cache to paged mode: entries are page reference
+        lists allocated from ``arena``; the arena budget replaces
+        ``capacity_bytes`` as the byte bound (still reported, for the
+        Cache: line's footing)."""
+        with self._lock:
+            if self._entries:
+                raise RuntimeError("attach_arena on a non-empty cache: "
+                                   "blob and paged entries must never "
+                                   "coexist")
+            self._arena = arena
+            self.capacity_bytes = int(arena.nbytes)
+
+    @property
+    def paged(self) -> bool:
+        return self._arena is not None
+
+    def acquire(self, key: tuple):
+        """Paged-mode hit path: counted lookup -> pinned
+        ``rnb_tpu.pager.GatherPlan`` (flat slab rows for the entry's
+        valid rows) or None. The caller overlays the rows on device at
+        the consumption seam and releases the plan once its gather
+        dispatched; pages evicted in between park in limbo, so the
+        plan's rows can never be recycled under it."""
+        arena = self._arena
+        assert arena is not None, "acquire() is the paged hit path"
+        from rnb_tpu.pager import GatherPlan
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.num_misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.num_hits += 1
+            with arena.pager.lock:
+                arena.pin_locked(entry.pages)
+            return GatherPlan(arena, entry.pages,
+                              arena.flat_rows(entry.pages, entry.valid),
+                              entry.valid)
+
+    def insert_pages(self, key: tuple, src_pool, row0: int,
+                     valid: int) -> bool:
+        """Paged-mode insert: allocate pages, publish ``valid`` rows of
+        the already-transferred device pool (rows ``[row0, row0 +
+        valid)``) into the arena slab via the donated page writer, and
+        record the reference list. First writer wins; evicts LRU
+        entries (freeing their pages) until the allocation fits; an
+        entry needing more pages than the whole arena holds is counted
+        ``oversize`` and skipped — the only size an entry can still
+        exceed, since pages need not be contiguous."""
+        arena = self._arena
+        assert arena is not None, "insert_pages() is the paged insert"
+        valid = int(valid)
+        if valid < 1:
+            return False
+        with self._lock:
+            if key in self._entries:
+                return False
+            needed = arena.pages_needed(valid)
+            if needed > arena.num_pages:
+                self.num_oversize += 1
+                return False
+            with arena.pager.lock:
+                pages = None
+                while True:
+                    pages = arena.alloc_locked(needed)
+                    if pages is not None or not self._entries:
+                        break
+                    _, evicted = self._entries.popitem(last=False)
+                    self.resident_bytes -= evicted.nbytes
+                    self.num_evictions += 1
+                    arena.free_locked(evicted.pages)
+                if pages is None:
+                    # every evictable page is out and the rest are
+                    # pinned/limbo under live plans — skip, never block
+                    return False
+                arena.write_entry_locked(pages, src_pool, row0, valid)
+            entry = PagedEntry(pages, valid, needed * arena.page_bytes)
+            self._entries[key] = entry
+            self.resident_bytes += entry.nbytes
+            self.num_inserts += 1
+            return True
 
     def __len__(self) -> int:
         with self._lock:

@@ -37,7 +37,8 @@ RESERVED_KEYWORDS = [
 ROOT_KEYWORDS = [
     "video_path_iterator", "pipeline", "overload_policy",
     "fault_containment", "fault_plan", "popularity", "autotune",
-    "trace", "ragged", "handoff", "placement", "health", "deadline",
+    "trace", "ragged", "pager", "handoff", "placement", "health",
+    "deadline",
     "metrics", "devobs", "critpath", "whatif", "operator", "netedge",
     "_comment",
 ]
@@ -54,6 +55,9 @@ TRACE_KEYWORDS = ["enabled", "sample_hz", "max_events"]
 
 #: keys a root 'ragged' object may carry (rnb_tpu.ops.ragged)
 RAGGED_KEYWORDS = ["enabled", "pool_rows"]
+
+#: keys a root 'pager' object may carry (rnb_tpu.pager)
+PAGER_KEYWORDS = ["enabled", "page_rows", "pool_mb", "feature_cache"]
 
 #: keys a root 'handoff' object may carry (rnb_tpu.handoff)
 HANDOFF_KEYWORDS = ["enabled", "mode"]
@@ -221,6 +225,17 @@ class PipelineConfig:
     #: a flat row pool at ONE compiled shape with a rows_valid scalar
     #: and per-request segment offsets instead of padding to buckets
     ragged: Optional[Dict[str, Any]] = None
+    #: validated page-allocator spec ({"enabled": .., "page_rows": ..,
+    #: "pool_mb": .., "feature_cache": ..}), or None; when enabled the
+    #: launcher builds one rnb_tpu.pager.Pager (fixed-size device row
+    #: pages under one slab per arena) shared by every
+    #: ``SUPPORTS_PAGER`` stage: clip-cache entries become page
+    #: reference lists gathered on device at the consumption seam
+    #: (zero host memcpy on hits), and — with ``feature_cache`` true —
+    #: post-stage activation rows are cached on feature pages so a
+    #: repeat request skips the backbone. Requires ``ragged`` (the
+    #: gather seam is the one pool shape). Absent => byte-stable logs.
+    pager: Optional[Dict[str, Any]] = None
     #: validated device-resident handoff spec ({"enabled": ..,
     #: "mode": "device"|"host"}), or None for the pre-handoff edge
     #: semantics (stage models re-home their own inputs, no
@@ -603,6 +618,42 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                         for step in pipeline if isinstance(step, dict)),
                     "'ragged' cannot be combined with 'num_segments' "
                     "> 1: the pool is one fixed dispatch shape")
+
+    pager = raw.get("pager")
+    if pager is not None:
+        _expect(isinstance(pager, dict), "'pager' must be an object")
+        unknown_pg = sorted(set(pager) - set(PAGER_KEYWORDS))
+        _expect(not unknown_pg,
+                "'pager' has unknown key(s) %s — keys are %s"
+                % (unknown_pg, PAGER_KEYWORDS))
+        _expect(isinstance(pager.get("enabled", True), bool),
+                "'pager.enabled' must be a boolean")
+        page_rows = pager.get("page_rows")
+        _expect(page_rows is None
+                or (isinstance(page_rows, int)
+                    and not isinstance(page_rows, bool)
+                    and page_rows >= 1),
+                "'pager.page_rows' must be a positive integer (rows "
+                "per fixed-size page), got %r" % (page_rows,))
+        pool_mb = pager.get("pool_mb")
+        _expect(pool_mb is None
+                or (isinstance(pool_mb, (int, float))
+                    and not isinstance(pool_mb, bool)
+                    and pool_mb > 0),
+                "'pager.pool_mb' must be a positive number (per-arena "
+                "page budget; omit to size from the cache budget), "
+                "got %r" % (pool_mb,))
+        _expect(isinstance(pager.get("feature_cache", False), bool),
+                "'pager.feature_cache' must be a boolean")
+        if pager.get("enabled", True):
+            # the gather-from-pages seam overlays rows onto the ONE
+            # ragged pool shape after its transfer; bucketed emissions
+            # have no single dispatch pool to gather into
+            _expect(isinstance(ragged, dict)
+                    and ragged.get("enabled", True),
+                    "'pager' requires 'ragged': paged cache hits "
+                    "gather into the ragged row pool at its one "
+                    "compiled shape")
 
     handoff = raw.get("handoff")
     if handoff is not None:
@@ -1111,6 +1162,7 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           popularity=popularity,
                           autotune=autotune,
                           ragged=ragged,
+                          pager=pager,
                           handoff=handoff,
                           placement=placement,
                           health=health,

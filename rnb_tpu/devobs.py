@@ -586,6 +586,7 @@ class DevObsPlane:
             "staging": metrics.name("memory.staging_bytes"),
             "ragged_pool": metrics.name("memory.ragged_pool_bytes"),
             "handoff": metrics.name("memory.handoff_bytes"),
+            "page_pool": metrics.name("memory.page_pool_bytes"),
         }
         for owner, nbytes in sorted(record["owners"].items()):
             gauge_name = owner_gauges.get(owner)

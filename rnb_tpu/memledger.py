@@ -77,6 +77,10 @@ MEM_OWNER_REGISTRY = (
     OwnerSpec("handoff", "rnb_tpu/handoff.py",
               "payload bytes resident from the consumer's most recent "
               "edge adoption/reshard (rnb_tpu.handoff)"),
+    OwnerSpec("page_pool", "rnb_tpu/pager.py",
+              "page-allocator arena slabs (paged clip-cache rows and "
+              "feature pages) plus the shared zero pools feature hits "
+              "dispatch with (rnb_tpu.pager)"),
 )
 
 MEM_OWNERS = tuple(spec.name for spec in MEM_OWNER_REGISTRY)

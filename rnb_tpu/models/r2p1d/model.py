@@ -290,7 +290,8 @@ class _DecodeHandle:
     """
 
     __slots__ = ("out", "n", "pool", "tickets", "future", "cached",
-                 "leader", "key", "error", "slot", "row0")
+                 "leader", "key", "error", "slot", "row0",
+                 "gather_plan", "feature_plan")
 
     def __init__(self, out, n, pool=None, tickets=None, future=None,
                  cached=None, leader=None, key=None, slot=None,
@@ -306,6 +307,8 @@ class _DecodeHandle:
         self.error = None       # sticky decode failure (see class doc)
         self.slot = slot        # StagingSlot the decode targets, or None
         self.row0 = row0        # first row of this decode in the slot
+        self.gather_plan = None  # pinned paged-cache hit (rnb_tpu.pager)
+        self.feature_plan = None  # pinned feature-page hit, or None
 
     def wait(self, video: str = "<video>") -> None:
         if self.leader is not None:
@@ -385,6 +388,11 @@ class R2P1DLoader(StageModel):
     #: of padding to buckets (root 'ragged' config key; the launcher
     #: injects the kwargs — rnb_tpu.ops.ragged)
     SUPPORTS_RAGGED = True
+
+    #: with the root 'pager' config key the clip cache's blob storage
+    #: becomes page-table entries in a pager arena and hits gather on
+    #: device with zero host bytes (rnb_tpu.pager; enable_pager below)
+    SUPPORTS_PAGER = True
 
     def __init__(self, device, max_clips: int = MAX_CLIPS,
                  consecutive_frames: int = CONSECUTIVE_FRAMES,
@@ -578,6 +586,15 @@ class R2P1DLoader(StageModel):
                 # the dct wire row length depends on the coefficient
                 # budget: two budgets must never alias one entry
                 self.dct_coeffs)
+        # Paged device memory (rnb_tpu.pager), wired by the executor
+        # via enable_pager(): the clip cache's blob storage becomes
+        # page-table entries in a pager arena (hits gather on device,
+        # zero host bytes) and — under pager.feature_cache — repeat
+        # requests can skip the downstream forward entirely
+        self.pager = None
+        self._clip_arena = None
+        self._zero_pool = None
+        self._feature_stub = None
         self._preprocess_ragged = None
         #: jit-entry signature accounting (rnb_tpu.compilestats):
         #: distinct preprocess input signatures == executables this
@@ -770,6 +787,61 @@ class R2P1DLoader(StageModel):
             self.staging.retire_ref(slot)
             handle.slot = None
 
+    def _release_handle_plan(self, handle) -> None:
+        """Release a handle's pinned page plans (drop/shed/failure
+        paths, idempotent): pages an eviction parked in limbo under
+        the pin re-enter the free list, so a shed hit can never leak
+        pages (rnb_tpu.pager pin/limbo discipline)."""
+        for attr in ("gather_plan", "feature_plan", "cached"):
+            plan = getattr(handle, attr, None)
+            if plan is not None and hasattr(plan, "release"):
+                plan.release()
+                if attr != "cached":
+                    setattr(handle, attr, None)
+
+    def enable_pager(self, pager) -> None:
+        """Executor protocol (rnb_tpu.runner): install the page
+        allocator. The clip cache switches to page-table entries in a
+        fresh ``clips`` arena sized from the cache's own byte budget
+        (the bytes the blob cache would have owned), and the loader
+        preallocates the ONE pool-shaped device zero array that
+        full-gather hits and feature hits dispatch with — a hit then
+        ships zero host memcpy bytes. Requires ragged dispatch (the
+        pool is the one gather seam) and an enabled clip cache."""
+        import jax
+        if not self.ragged:
+            raise ValueError(
+                "pager requires ragged dispatch: paged gathers "
+                "overlay rows of the ONE pool shape (configure the "
+                "root 'ragged' key)")
+        if self.cache is None:
+            raise ValueError(
+                "pager requires an enabled clip cache (cache_mb): "
+                "the page arena replaces its blob storage")
+        self.pager = pager
+        pager.size_hint(self.cache.capacity_bytes)
+        self._clip_arena = pager.create_arena(
+            "clips", self._batch_shape(1)[1:], self._wire_dtype,
+            budget_bytes=self.cache.capacity_bytes,
+            device=self._jax_device)
+        self.cache.attach_arena(self._clip_arena)
+        zeros = np.zeros(self._batch_shape(self.pool_rows),
+                         dtype=self._wire_dtype)
+        self._zero_pool = jax.device_put(zeros, self._jax_device)
+        pager.adopt_shared("loader-zero-pool", self._zero_pool,
+                           device_label=str(self._jax_device))
+        # feature hits ship a stub emission downstream (the consumer
+        # gathers its own output rows and never reads the payload);
+        # the stub must still BE the declared wire value — normalized
+        # once here, outside the measured window
+        stub = self._normalize_emission(self._zero_pool, 0)
+        if stub is not self._zero_pool:
+            import jax as _jax
+            _jax.block_until_ready(stub)
+            pager.adopt_shared("loader-feature-stub", stub,
+                               device_label=str(self._jax_device))
+        self._feature_stub = stub
+
     def _decode_sync(self, decoder, video, starts):
         """Synchronous decode through this loader's pixel path."""
         if self.pixel_path == "yuv420":
@@ -867,16 +939,46 @@ class R2P1DLoader(StageModel):
                 self._starts_cache[video] = starts
         return starts
 
-    def _cache_lookup(self, video: str):
+    def _cache_lookup(self, video: str, key=None):
         """(key, entry) for one request — (None, None) when caching is
         off. Counted and hostprof-sectioned: the lookup (one stat + one
-        dict probe) is the only cost a cache-enabled miss adds."""
+        dict probe) is the only cost a cache-enabled miss adds. Under
+        a paged cache the hit value is a pinned GatherPlan
+        (rnb_tpu.cache.ClipCache.acquire), not a blob entry. ``key``
+        short-circuits the content hash when the caller already
+        computed it (the feature-page probe)."""
         if self.cache is None:
             return None, None
         with hostprof.section("loader.cache_lookup"):
-            key = content_key(video, self._cache_cfg)
-            entry = self.cache.lookup(key)
+            if key is None:
+                key = content_key(video, self._cache_cfg)
+            if self.cache.paged:
+                entry = self.cache.acquire(key)
+            else:
+                entry = self.cache.lookup(key)
         return key, entry
+
+    def _feature_probe(self, video: str):
+        """(content_key, plan): probe the feature-page cache ahead of
+        the clip cache — a hit there supersedes everything (the whole
+        stage-0..N work is skipped). (None, None) when feature pages
+        are off; (key, None) on a plain miss, the key then feeds
+        :meth:`_cache_lookup` so the content hash runs once."""
+        if self.pager is None or self.pager.feature is None \
+                or self.cache is None:
+            return None, None
+        key = content_key(video, self._cache_cfg)
+        return key, self.pager.feature.acquire(key)
+
+    def _stamp_feature_insert(self, time_card, key, row0: int,
+                              n: int) -> None:
+        """Mark one successfully transferred request's pool row range
+        as a feature-insert candidate: the CONSUMING stage performs
+        the insert strictly after its forward returned
+        (insert-after-success), reading the stamp off the card."""
+        if self.pager is not None and self.pager.feature is not None \
+                and self.pager.feature.ready and key is not None:
+            time_card.feature_insert = (key, int(row0), int(n))
 
     def _materialize_hit(self, entry, time_card):
         """Serve one request from a cache entry: no decode, no
@@ -897,6 +999,8 @@ class R2P1DLoader(StageModel):
             if self._trace_step is not None:
                 _record_clamped(time_card, "decode%d_done"
                                 % self._trace_step, time.time())
+            if self.cache.paged:
+                return self._materialize_pages(entry, time_card)
             return self._materialize(entry.batch, entry.valid,
                                      time_card)
         if self._trace_step is not None:
@@ -915,6 +1019,58 @@ class R2P1DLoader(StageModel):
                                                      entry.valid),
                             entry.valid),), None, time_card
 
+    def _materialize_pages(self, plan, time_card):
+        """Serve a paged ragged hit with ZERO host bytes: the entry's
+        page rows gather straight over the preallocated device zero
+        pool — no decode, no staging rows, no host memcpy, no
+        device_put (the staging plane counts a bypassed emission).
+        The gather feeds the identical normalize dispatch a miss
+        feeds, so hit/miss logits stay bit-identical."""
+        n = plan.valid
+        if self._trace_step is not None:
+            # no transfer happens: zero-length phases keep the card's
+            # key sequence identical to a miss (TimeCardSummary
+            # asserts one schema per step instance)
+            now = time.time()
+            step = self._trace_step
+            _record_clamped(time_card, "transfer%d_start" % step, now)
+            _record_clamped(time_card, "transfer%d_done" % step, now)
+        src = np.full((self.pool_rows,), -1, np.int32)
+        src[:n] = plan.src_rows
+        with hostprof.section("loader.cache_gather"):
+            device_u8 = self._clip_arena.gather(self._zero_pool, src)
+        plan.release()
+        if self.staging is not None:
+            self.staging.note_bypassed()
+        self._note_emission_padding(n, self.pool_rows, [time_card])
+        batch = self._normalize_emission(device_u8, n)
+        return (self._wrap_batch(batch, n),), None, time_card
+
+    def _materialize_feature(self, plan, time_card):
+        """A feature-page hit: the request skips decode, transfer AND
+        the downstream forward. The emission ships the preallocated
+        stub pool (never read downstream) and the pinned plan rides
+        the time card to the consuming stage, which gathers the exact
+        output rows the original request computed and releases the
+        pin. Insert-after-success upstream guarantees those rows came
+        from a forward that returned."""
+        n = plan.valid
+        time_card.num_clips = n
+        time_card.feature_hit = True
+        time_card.feature_plan = plan
+        if self._trace_step is not None:
+            now = time.time()
+            step = self._trace_step
+            _record_clamped(time_card, "decode%d_done" % step, now)
+            _record_clamped(time_card, "transfer%d_start" % step, now)
+            _record_clamped(time_card, "transfer%d_done" % step, now)
+        self.pager.note_feature_saved(n * self._clip_arena.row_bytes)
+        if self.staging is not None:
+            self.staging.note_bypassed()
+        self._note_emission_padding(n, self.pool_rows, [time_card])
+        return (self._wrap_batch(self._feature_stub, n),), None, \
+            time_card
+
     def submit(self, non_tensors, time_card) -> _DecodeHandle:
         """Kick off decode of one request; pair with :meth:`complete`.
 
@@ -929,7 +1085,14 @@ class R2P1DLoader(StageModel):
         buffer — no second decode) instead of re-submitting.
         """
         video = str(non_tensors)
-        key, entry = self._cache_lookup(video)
+        fkey, fplan = self._feature_probe(video)
+        if fplan is not None:
+            handle = _DecodeHandle(None, fplan.valid)
+            handle.feature_plan = fplan
+            time_card.num_clips = fplan.valid
+            time_card.feature_hit = True
+            return handle
+        key, entry = self._cache_lookup(video, key=fkey)
         if entry is not None:
             time_card.num_clips = entry.valid
             time_card.cache_hit = True
@@ -1047,7 +1210,7 @@ class R2P1DLoader(StageModel):
             padded = np.zeros(target, dtype=self._wire_dtype)
             padded[:n] = clips
         if cache_key is not None and self.cache is not None \
-                and self.ragged:
+                and self.ragged and not self.cache.paged:
             # ragged entries are host row extents (exactly n rows,
             # no pool padding) — copied out here, before the transfer,
             # while the decode buffer is live
@@ -1063,6 +1226,14 @@ class R2P1DLoader(StageModel):
             _record_clamped(time_card,
                             "transfer%d_done" % self._trace_step,
                             time.time())
+        if cache_key is not None and self.cache is not None \
+                and self.ragged and self.cache.paged:
+            # paged insert is post-transfer DEVICE work (insert-after-
+            # success and zero extra host copies): pool rows [0, n)
+            # publish into pages by donated on-device writes
+            with hostprof.section("loader.cache_insert"):
+                self.cache.insert_pages(cache_key, device_u8, 0, n)
+            self._stamp_feature_insert(time_card, cache_key, 0, n)
         if cache_key is not None and self.cache is not None \
                 and not self.ragged:
             # zero-copy insert: the padded device array IS the cached
@@ -1092,7 +1263,7 @@ class R2P1DLoader(StageModel):
             # — up to pool-1 rows per request — is pure host waste
             slot.buf[n:] = 0
         if cache_key is not None and self.cache is not None \
-                and self.ragged:
+                and self.ragged and not self.cache.paged:
             # ragged entries are host row extents, copied out of the
             # slot while its rows are still live (pre-handoff)
             with hostprof.section("loader.cache_insert"):
@@ -1113,6 +1284,12 @@ class R2P1DLoader(StageModel):
                             time.time())
         self._release_handle_slot(handle)
         if cache_key is not None and self.cache is not None \
+                and self.ragged and self.cache.paged:
+            # paged insert, post-transfer (see _materialize)
+            with hostprof.section("loader.cache_insert"):
+                self.cache.insert_pages(cache_key, device_u8, 0, n)
+            self._stamp_feature_insert(time_card, cache_key, 0, n)
+        if cache_key is not None and self.cache is not None \
                 and not self.ragged:
             # still zero-copy: the cached device array owns its bytes
             # once the transfer is confirmed; the slot recycle gate
@@ -1127,6 +1304,9 @@ class R2P1DLoader(StageModel):
     def complete(self, handle: _DecodeHandle, non_tensors, time_card):
         """Wait for a submitted decode, then pad/transfer/normalize
         (or serve the cached/coalesced result without decode work)."""
+        if handle.feature_plan is not None:
+            plan, handle.feature_plan = handle.feature_plan, None
+            return self._materialize_feature(plan, time_card)
         if handle.cached is not None:
             return self._materialize_hit(handle.cached, time_card)
         if handle.leader is not None:
@@ -1172,6 +1352,7 @@ class R2P1DLoader(StageModel):
         except Exception:
             pass  # abort path: decode errors are moot
         self._release_handle_slot(handle)
+        self._release_handle_plan(handle)
         if self._inflight_keys is not None:
             self._inflight_keys.pop(getattr(handle, "key", None))
 
@@ -1180,7 +1361,10 @@ class R2P1DLoader(StageModel):
         # decode inline on the calling thread — no thread-pool hop, no
         # extra staging copy on the hot path
         video = str(non_tensors)
-        key, entry = self._cache_lookup(video)
+        fkey, fplan = self._feature_probe(video)
+        if fplan is not None:
+            return self._materialize_feature(fplan, time_card)
+        key, entry = self._cache_lookup(video, key=fkey)
         if entry is not None:
             return self._materialize_hit(entry, time_card)
         decoder = get_decoder(video)
@@ -1200,13 +1384,14 @@ class _FuseRecord:
     coalesced followers' (rnb_tpu.cache), which share the single
     decode and the single fused emission."""
 
-    __slots__ = ("handle", "video", "cards", "key", "t_ready")
+    __slots__ = ("handle", "video", "cards", "key", "fkey", "t_ready")
 
-    def __init__(self, handle, video, card, key=None):
+    def __init__(self, handle, video, card, key=None, fkey=None):
         self.handle = handle
         self.video = video
         self.cards = [card]
         self.key = key       # cache key, or None when caching is off
+        self.fkey = fkey     # content key for feature-page inserts
         self.t_ready = 0.0   # monotonic instant the decode was harvested
 
 
@@ -1347,6 +1532,10 @@ class R2P1DFusingLoader(R2P1DLoader):
             if all(_deadline_expired(tc) for tc in rec.cards):
                 self._drop_coalesce(rec)
                 self._release_handle_slot(rec.handle)
+                # a shed paged hit releases its pin before its gather
+                # ever dispatches — counted hit rows therefore bound
+                # gather rows from above, never equal them exactly
+                self._release_handle_plan(rec.handle)
                 self._deadline_shed.extend((tc, "hold")
                                            for tc in rec.cards)
             else:
@@ -1400,9 +1589,11 @@ class R2P1DFusingLoader(R2P1DLoader):
         """Every card riding this record — leader and coalesced
         followers — fails as a unit; none is ever cached. A contained
         failure releases its staging-slot rows (the slot recycles once
-        its surviving batchmates are through)."""
+        its surviving batchmates are through) and any pinned page
+        plan, and never stamps a feature insert."""
         self._drop_coalesce(rec)
         self._release_handle_slot(rec.handle)
+        self._release_handle_plan(rec.handle)
         self._failed.extend((tc, reason) for tc in rec.cards)
 
     def _staging_default_slots(self) -> int:
@@ -1630,7 +1821,28 @@ class R2P1DFusingLoader(R2P1DLoader):
                     _record_clamped(tc, "transfer%d_start" % step,
                                     now_epoch)
         out, slot = self._assemble(ok, rows, bucket)
-        if self.cache is not None:
+        gather_plans = None
+        insert_jobs = None
+        if self.cache is not None and self.cache.paged:
+            # paged cache: hit rows overlay from the clip arena and
+            # miss rows publish into pages — both on DEVICE, after
+            # the pool's transfer (_overlay_pages in the transfer
+            # body), so the host-side insert/hit memcpys of the blob
+            # path below are deleted outright. Insert-after-success
+            # holds: the jobs run only once device_put returned.
+            gather_plans = []
+            insert_jobs = []
+            for i, rec in enumerate(ok):
+                h = rec.handle
+                row0 = int(offsets[i])
+                if h.gather_plan is not None:
+                    gather_plans.append((row0, h.gather_plan))
+                    h.gather_plan = None
+                elif rec.key is not None:
+                    insert_jobs.append((rec.key, row0, h.n))
+                self._stamp_feature_insert(rec.cards[0], rec.fkey,
+                                           row0, h.n)
+        elif self.cache is not None:
             # insert-after-success: only decodes that reached this
             # point populate the cache. Both insert flavors copy the
             # rows out of the slot BEFORE the transfer/recycle below,
@@ -1675,10 +1887,12 @@ class R2P1DFusingLoader(R2P1DLoader):
             self._worker.submit(
                 lambda: self._transfer_job(out, slot, rows, cards,
                                            service_key, t_close,
-                                           offsets))
+                                           offsets, gather_plans,
+                                           insert_jobs))
             return True
         self._transfer_sync(out, slot, rows, cards, service_key,
-                            t_close, offsets)
+                            t_close, offsets, gather_plans,
+                            insert_jobs)
         return True
 
     def _min_live_row(self, slot) -> int:
@@ -1750,9 +1964,34 @@ class R2P1DFusingLoader(R2P1DLoader):
             self.staging.note_copied()
         return out, None
 
+    def _overlay_pages(self, batch, gather_plans, insert_jobs):
+        """Paged-cache device work for one emission, strictly after
+        its pool transfer: overlay hit rows from the clip arena (the
+        only place they ever materialize — their slot rows shipped
+        uninitialized) and publish miss rows into pages
+        (insert-after-success: decode and transfer both completed by
+        now). Runs before the normalize dispatch, so gathered hit
+        rows feed the identical jitted path a miss feeds."""
+        if gather_plans:
+            src = np.full((int(batch.shape[0]),), -1, np.int32)
+            for row0, plan in gather_plans:
+                src[row0:row0 + plan.valid] = plan.src_rows
+            with hostprof.section("loader.cache_gather"):
+                batch = self._clip_arena.gather(batch, src)
+            for _, plan in gather_plans:
+                # dispatched: the gather captured the slab value, so
+                # the pins can release (rnb_tpu.pager limbo rule)
+                plan.release()
+        if insert_jobs:
+            with hostprof.section("loader.cache_insert"):
+                for key, row0, n in insert_jobs:
+                    self.cache.insert_pages(key, batch, row0, n)
+        return batch
+
     def _transfer_sync(self, out, slot, rows: int, cards,
                        bucket: int, t_close: float,
-                       offsets=None) -> None:
+                       offsets=None, gather_plans=None,
+                       insert_jobs=None) -> None:
         """Inline transfer on the executor thread (transfer_async
         off): the seed path minus the assembly — the transfer is
         confirmed lazily at the slot's next acquire, so the executor
@@ -1763,6 +2002,9 @@ class R2P1DFusingLoader(R2P1DLoader):
             batch = jax.device_put(out, self._jax_device)
         if slot is not None:
             self.staging.finish_transfer(slot, batch)
+        if gather_plans is not None or insert_jobs is not None:
+            batch = self._overlay_pages(batch, gather_plans,
+                                        insert_jobs)
         if self._trace_step is not None:
             at = time.time()
             for tc in cards:
@@ -1778,7 +2020,8 @@ class R2P1DFusingLoader(R2P1DLoader):
 
     def _transfer_job(self, out, slot, rows: int, cards,
                       bucket: int, t_close: float,
-                      offsets=None) -> None:
+                      offsets=None, gather_plans=None,
+                      insert_jobs=None) -> None:
         """Transfer-worker body: issue the device_put for batch N
         while the executor decodes batch N+1 into the next slot;
         confirm completion (alias-probed) before releasing the slot's
@@ -1790,6 +2033,9 @@ class R2P1DFusingLoader(R2P1DLoader):
         if slot is not None:
             with hostprof.section("transfer.confirm"):
                 self.staging.confirm_now(slot, batch)
+        if gather_plans is not None or insert_jobs is not None:
+            batch = self._overlay_pages(batch, gather_plans,
+                                        insert_jobs)
         if self._trace_step is not None:
             at = time.time()
             for tc in cards:
@@ -1932,27 +2178,49 @@ class R2P1DFusingLoader(R2P1DLoader):
 
     def __call__(self, tensors, non_tensors, time_card):
         video = str(non_tensors)
-        key, entry = self._cache_lookup(video)
+        fkey, fplan = self._feature_probe(video)
+        if fplan is not None:
+            # feature-page hit: no decode, no transfer, no downstream
+            # forward — emit standalone immediately (holding it for
+            # fusion would only add latency; there is nothing to
+            # amortize), like the bucketed _emit_hit below
+            tensors_out, nt, tc = self._materialize_feature(
+                fplan, time_card)
+            return tensors_out, nt, TimeCardList([tc])
+        key, entry = self._cache_lookup(video, key=fkey)
         if entry is not None and self.ragged:
-            # ragged hit: the cached HOST row extent fills its pool
-            # rows like a decode that completed instantly — it rides
-            # the next fused emission (one pool transfer for hits and
-            # misses alike) instead of dispatching standalone. The
-            # decode is skipped; the memcpy into the slot slice is the
-            # whole cost.
+            # ragged hit: the hit fills its pool rows like a decode
+            # that completed instantly — it rides the next fused
+            # emission (one pool transfer for hits and misses alike)
+            # instead of dispatching standalone.
             n = entry.valid
             time_card.num_clips = n
             time_card.cache_hit = True
             if self.ragged_stats is not None:
                 self.ragged_stats["cache_hit_rows"] += n
             target, hit_slot, hit_row0 = self._stage_target(n)
-            np.copyto(target, entry.batch[:n])
-            handle = _DecodeHandle(target, n, slot=hit_slot,
-                                   row0=hit_row0)
+            if self.cache.paged:
+                # zero-copy paged hit: the reserved slot rows ship
+                # UNINITIALIZED — the pinned plan rides the handle and
+                # the entry's page rows overlay them on device, after
+                # the pool's transfer (_overlay_pages). No host byte
+                # of this request ever moves.
+                handle = _DecodeHandle(target, n, slot=hit_slot,
+                                       row0=hit_row0)
+                handle.gather_plan = entry
+            else:
+                # blob hit: the decode is skipped; the memcpy into
+                # the slot slice is the whole cost (its own hostprof
+                # section, split from the lookup above)
+                with hostprof.section("loader.cache_gather"):
+                    np.copyto(target, entry.batch[:n])
+                handle = _DecodeHandle(target, n, slot=hit_slot,
+                                       row0=hit_row0)
             self._stamp_decode_done(time_card)
             if self.autotune is not None:
                 self.autotune.observe_rows(n)
-            rec = _FuseRecord(handle, video, time_card, key=None)
+            rec = _FuseRecord(handle, video, time_card, key=None,
+                              fkey=fkey)
             # join the in-flight window IN ARRIVAL ORDER (the handle
             # is already complete, so harvest promotes it at its FIFO
             # turn): jumping straight to _ready would reorder the
@@ -1990,7 +2258,7 @@ class R2P1DFusingLoader(R2P1DLoader):
             # target into a residual request count (coalesced
             # followers add cards, not rows, so they do not feed this)
             self.autotune.observe_rows(handle.n)
-        rec = _FuseRecord(handle, video, time_card, key=key)
+        rec = _FuseRecord(handle, video, time_card, key=key, fkey=fkey)
         if key is not None:
             self._inflight_keys.put(key, rec)
         self._inflight.append(rec)
@@ -2093,6 +2361,12 @@ class R2P1DRunner(StageModel):
     #: its yuv420 fused ingest masks the pool tail via the ragged
     #: primitive (root 'ragged' config key, rnb_tpu.ops.ragged)
     SUPPORTS_RAGGED = True
+
+    #: under pager.feature_cache this stage is the feature-page
+    #: consumer: it inserts its output rows after each successful
+    #: forward and serves feature hits by gathering them back
+    #: (rnb_tpu.pager; enable_pager below)
+    SUPPORTS_PAGER = True
 
     def __init__(self, device, start_index: int = 1,
                  end_index: int = NUM_LAYERS,
@@ -2205,6 +2479,10 @@ class R2P1DRunner(StageModel):
             warm_rows = _normalize_row_buckets(row_buckets,
                                                self.max_rows,
                                                "max_rows")
+        # feature pages (rnb_tpu.pager), wired via enable_pager()
+        self.pager = None
+        self._feature_arena = None
+        self._logit_pool = None
         #: jit-entry signature accounting (rnb_tpu.compilestats):
         #: distinct applier input signatures == executables this stage
         #: requires; frozen by the executor at measured-window start
@@ -2230,6 +2508,81 @@ class R2P1DRunner(StageModel):
 
     def input_shape(self):
         return (self._steady_shape,)
+
+    def enable_pager(self, pager) -> None:
+        """Executor protocol (rnb_tpu.runner): attach this stage as
+        the feature-page consumer. Its config fingerprint keys every
+        entry (two configs can never alias), its ``features`` arena
+        holds output logit rows written strictly after each
+        successful forward, and a feature hit gathers those exact
+        rows back over a preallocated zero pool — bit-identical to
+        re-running the forward, because they ARE the original
+        forward's rows."""
+        import jax
+        self.pager = pager
+        if pager.feature is None:
+            return
+        if not self.ragged:
+            raise ValueError(
+                "pager.feature_cache requires ragged dispatch on the "
+                "consuming stage: feature rows gather into the ONE "
+                "pool shape")
+        num_classes = int(self._flops_args["num_classes"])
+        if self.end_index != NUM_LAYERS:
+            raise ValueError(
+                "pager.feature_cache requires the consuming stage to "
+                "end the network (end_index=%d): cached rows must be "
+                "final outputs, not mid-pipeline activations another "
+                "stage still transforms" % (self.end_index,))
+        fingerprint = (
+            "r2p1d-logits", self.start_index, self.end_index,
+            num_classes, self._flops_args["layer_sizes"],
+            self._flops_args["factored_shortcut"],
+            self._flops_args["consecutive_frames"],
+            self.pixel_path, self.dct_coeffs_per_frame)
+        self._feature_arena = pager.create_arena(
+            "features", (num_classes,), np.float32,
+            device=self._jax_device,
+            gather_keys=("feature_gathers", "feature_gather_rows"))
+        pager.feature.attach(self._feature_arena, fingerprint)
+        zeros = np.zeros((self.pool_rows, num_classes), np.float32)
+        self._logit_pool = jax.device_put(zeros, self._jax_device)
+        pager.adopt_shared("runner-logit-pool", self._logit_pool,
+                           device_label=str(self._jax_device))
+
+    def _take_feature_plan(self, time_card):
+        """The pinned feature-page plan riding this dispatch's card,
+        if any (stamped by the loader's feature-hit emission), removed
+        from the card so downstream consumers never see it."""
+        if self.pager is None or self.pager.feature is None:
+            return None
+        cards = (time_card.time_cards
+                 if isinstance(time_card, TimeCardList)
+                 else (time_card,))
+        for tc in cards:
+            plan = getattr(tc, "feature_plan", None)
+            if plan is not None:
+                tc.feature_plan = None
+                return plan
+        return None
+
+    def _insert_features(self, out, time_card) -> None:
+        """Publish this forward's output rows for every constituent
+        request the loader stamped (insert-after-success: this runs
+        only once ``_apply`` returned; contained failures and sheds
+        never reach it)."""
+        feature = None if self.pager is None else self.pager.feature
+        if feature is None or not feature.ready:
+            return
+        cards = (time_card.time_cards
+                 if isinstance(time_card, TimeCardList)
+                 else (time_card,))
+        for tc in cards:
+            job = getattr(tc, "feature_insert", None)
+            if job is not None:
+                tc.feature_insert = None
+                key, row0, n = job
+                feature.insert(key, out, row0, n)
 
     def _cost_bytes_per_row(self):
         """Per-row "bytes accessed" from XLA's own cost model of the
@@ -2365,12 +2718,28 @@ class R2P1DRunner(StageModel):
     def __call__(self, tensors, non_tensors, time_card):
         jax, _ = _jax_numpy()
         pb = tensors[0]
+        fplan = self._take_feature_plan(time_card)
+        if fplan is not None:
+            # feature-page hit: the loader shipped a stub pool and
+            # skipped decode + transfer; this stage skips the whole
+            # forward and gathers the exact logit rows the original
+            # request computed over a preallocated zero pool
+            src = np.full((int(self._logit_pool.shape[0]),), -1,
+                          np.int32)
+            src[:fplan.valid] = fplan.src_rows
+            out = self._feature_arena.gather(self._logit_pool, src)
+            fplan.release()
+            offsets = getattr(pb, "segment_offsets",
+                              (0, int(pb.valid)))
+            return (RaggedBatch(out, pb.valid, offsets),), \
+                non_tensors, time_card
         x = jax.device_put(pb.data, self._jax_device)
         self.compiles.observe(x)
         if self.ragged:
             out = self._apply(self._variables, x, np.int32(pb.valid))
         else:
             out = self._apply(self._variables, x)
+        self._insert_features(out, time_card)
         if self.ragged:
             # the pool shape rides through: downstream consumers (and
             # the executor's payload validation) see the same segment

@@ -166,6 +166,12 @@ class RunnerContext:
     #: controller-owning stages append their final decision/deadline
     #: counters here (BenchmarkResult + log-meta `Autotune:` line)
     autotune_sink: Optional[List] = None
+    #: paged device memory (root 'pager' config key, rnb_tpu.pager):
+    #: the job's one Pager when enabled, else None. The executor
+    #: calls model.enable_pager() on SUPPORTS_PAGER stages before the
+    #: start barrier — the loader switches its clip cache to page
+    #: tables, the consuming stage attaches the feature-page arena
+    pager: Optional[Any] = None
     #: every stage appends ``(step_idx, warmup_s, sigs-or-None)`` here:
     #: construction wall time plus — for stages owning a jit applier —
     #: the SignatureTracker snapshot (rnb_tpu.compilestats), feeding
@@ -703,8 +709,14 @@ def runner(ctx: RunnerContext) -> None:
             # consumer's side of the edge contract, re-home target
             # refined by the stage's input_sharding() when declared
             from rnb_tpu.handoff import EdgeHandoff
-            handoff = EdgeHandoff(ctx.handoff_settings, ctx.device,
-                                  ctx.handoff_edge, model)
+            handoff = EdgeHandoff(
+                ctx.handoff_settings, ctx.device, ctx.handoff_edge,
+                model,
+                # pager-owned shared pools (feature-hit stubs) are
+                # footed under the page_pool ledger owner — exclude
+                # them from this edge's residency claim
+                external_owner=(ctx.pager.owns
+                                if ctx.pager is not None else None))
         if ctx.autotune is not None \
                 and getattr(model, "SUPPORTS_AUTOTUNE", False):
             # load-adaptive batching (rnb_tpu.autotune): the stage
@@ -712,6 +724,12 @@ def runner(ctx: RunnerContext) -> None:
             # a bucket restriction it never warms is rejected here
             # (and statically by rnb-lint RNB-G006)
             controller = model.enable_autotune(ctx.autotune)
+        if ctx.pager is not None \
+                and getattr(model, "SUPPORTS_PAGER", False):
+            # paged device memory (rnb_tpu.pager): arenas allocate and
+            # register with the memory ledger here, pre-barrier, so
+            # every Memory:/Pages: sample covers the full page pool
+            model.enable_pager(ctx.pager)
         if ctx.tracer is not None and hasattr(model, "enable_trace"):
             # unified tracing (rnb_tpu.trace): stages that refine the
             # per-request phase stamps (decode/hold/transfer) and own
@@ -1272,8 +1290,16 @@ def runner(ctx: RunnerContext) -> None:
                             for tc_dv in cards_dv)
                         rows_dv = 0
                         for tc_dv in cards_dv:
+                            # coalesced rows share another request's
+                            # dispatch and feature-hit rows skipped
+                            # the forward entirely — neither ran
+                            # FLOPs, so both count 0 (honesty policy:
+                            # hits must never inflate MFU)
                             if not getattr(tc_dv, "cache_coalesced",
-                                           False):
+                                           False) \
+                                    and not getattr(tc_dv,
+                                                    "feature_hit",
+                                                    False):
                                 rows_dv += int(getattr(tc_dv,
                                                        "num_clips", 0))
                         devobs_meter.note(rows_dv,

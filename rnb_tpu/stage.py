@@ -239,6 +239,15 @@ class StageModel:
     #: The launcher injects the kwargs only for supporting classes.
     SUPPORTS_RAGGED = False
 
+    #: True for stages that implement the page-allocator contract
+    #: (root 'pager' config key, rnb_tpu.pager): they implement
+    #: ``enable_pager(pager)`` — loaders switch the clip cache to
+    #: paged entries and gather hits on device; consumers attach a
+    #: feature-page arena and serve repeat requests from cached
+    #: post-stage rows. The executor wires the shared Pager only for
+    #: supporting classes.
+    SUPPORTS_PAGER = False
+
     def __init__(self, device, **kwargs):
         self.device = device
 

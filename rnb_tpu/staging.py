@@ -137,6 +137,7 @@ class StagingPool:
         self.num_acquire_waits = 0
         self.num_staged_batches = 0
         self.num_copied_batches = 0
+        self.num_bypassed_batches = 0
         self.num_reallocs = 0
 
     # -- lifecycle ----------------------------------------------------
@@ -270,6 +271,16 @@ class StagingPool:
         with self._lock:
             self.num_copied_batches += 1
 
+    def note_bypassed(self) -> None:
+        """An emission shipped with **zero** host->device bytes — every
+        row was gathered on-device from the page allocator (full
+        cache-hit or feature-page hit, rnb_tpu.pager). No slot was
+        acquired and no transfer issued; counted separately so the
+        staged/copied split still foots against transfer-carrying
+        emissions only."""
+        with self._lock:
+            self.num_bypassed_batches += 1
+
     def fail(self, exc: BaseException) -> None:
         """Record a transfer-pipeline failure; every later acquire /
         raise_if_failed re-raises it (no silent hang)."""
@@ -312,6 +323,7 @@ class StagingPool:
                 "acquire_waits": self.num_acquire_waits,
                 "staged_batches": self.num_staged_batches,
                 "copied_batches": self.num_copied_batches,
+                "bypassed_batches": self.num_bypassed_batches,
                 "reallocs": self.num_reallocs,
             }
 
@@ -322,7 +334,8 @@ def aggregate_snapshots(snapshots: List[Dict[str, int]]) -> Dict[str, int]:
     instance owns its own pool)."""
     total = {"slots": 0, "slot_bytes": 0, "acquires": 0,
              "acquire_waits": 0, "staged_batches": 0,
-             "copied_batches": 0, "reallocs": 0}
+             "copied_batches": 0, "bypassed_batches": 0,
+             "reallocs": 0}
     for snap in snapshots:
         for k in total:
             total[k] += int(snap.get(k, 0))

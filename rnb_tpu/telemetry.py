@@ -65,6 +65,14 @@ def latency_percentiles(latencies_ms: Sequence[float],
 #: outcome (rnb_tpu.cache: True=hit, False=miss; cache_coalesced marks
 #: a request that shared another request's in-flight decode)
 CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced",
+                  # True when the request was answered from feature
+                  # pages (rnb_tpu.pager): the stage forward never ran,
+                  # so MFU accounting counts its rows 0 — the honesty
+                  # policy twin of cache_coalesced. (The feature_plan /
+                  # feature_insert carriers live in TRANSIENT_STAMPS
+                  # below instead: they hold live page pins / insert
+                  # obligations a fork would double-own.)
+                  "feature_hit",
                   # pad rows the emission carrying this request shipped
                   # (attributed to the emission's first constituent so
                   # sums stay exact; 0 on every other card and on every
@@ -90,6 +98,23 @@ CONTENT_STAMPS = ("num_clips", "cache_hit", "cache_coalesced",
                   # rid's terminal outcome; a re-claim would consume
                   # the sibling copy's LOSER slot)
                   "hedge_resolved")
+
+#: card-riding carriers that are DELIBERATELY not content stamps: they
+#: must NOT survive fork/merge. Each holds single-owner live state —
+#: copying it onto a hedge clone would double-own it. The schema
+#: checker (RNB-T007) accepts stamp sites for these names but the
+#: fork/merge copy loop above never touches them; both plans are
+#: released idempotently by the loader's failure/shed sweeps so a
+#: dropped card cannot strand a page pin.
+TRANSIENT_STAMPS = (
+    # rnb_tpu.pager.GatherPlan for a feature-page hit: pins live pages
+    # until the runner's logit gather releases them — exactly-once
+    # consumption, popped (set back to None) by the consuming stage
+    "feature_plan",
+    # (content_key, row_start, rows) insert obligation: must fire
+    # exactly once AFTER the forward succeeds; surviving a fork would
+    # double-insert the same rows
+    "feature_insert")
 
 
 # -- the declared telemetry schema ------------------------------------
@@ -160,6 +185,16 @@ META_LINE_REGISTRY = (
     StampSpec("Staging:", "rnb_tpu/benchmark.py",
               "zero-copy decode-staging pool counters "
               "(staging-enabled runs only)"),
+    StampSpec("Pages:", "rnb_tpu/benchmark.py",
+              "paged device-memory counters (rnb_tpu.pager): arena/"
+              "page occupancy (live/limbo/bytes), page allocs/frees/"
+              "alloc_fails, gather dispatches + rows split clip vs "
+              "feature plane, feature-cache lookups/hits/inserts/"
+              "evictions/bytes_saved, and emissions that shipped "
+              "zero host->device bytes (pager-enabled runs only; "
+              "--check holds allocs == frees + live at teardown, "
+              "feature_hits <= feature_lookups, and gather_rows <= "
+              "the ragged cache_hit_rows they serve)"),
     StampSpec("Autotune:", "rnb_tpu/benchmark.py",
               "load-adaptive batching controller counters "
               "(autotune-enabled runs only)"),
@@ -559,6 +594,44 @@ METRIC_REGISTRY = (
                "copy-fallback emissions"),
     MetricSpec("staging.reallocs", "counter", "poll",
                "alias-forced slot-buffer replacements"),
+    MetricSpec("pages.allocs", "counter", "poll",
+               "pages popped off arena free lists (rnb_tpu.pager)"),
+    MetricSpec("pages.frees", "counter", "poll",
+               "pages returned to arena free lists (incl. limbo "
+               "releases at unpin)"),
+    MetricSpec("pages.alloc_fails", "counter", "poll",
+               "page allocations refused for lack of free pages "
+               "(the caller evicts-and-retries or skips)"),
+    MetricSpec("pages.gathers", "counter", "poll",
+               "clip-arena gather kernels dispatched (one per "
+               "emission with paged hit rows)"),
+    MetricSpec("pages.gather_rows", "counter", "poll",
+               "rows overlaid from clip pages onto emission pools "
+               "(zero host bytes each)"),
+    MetricSpec("pages.feature_lookups", "counter", "poll",
+               "feature-cache probes at request admission"),
+    MetricSpec("pages.feature_hits", "counter", "poll",
+               "feature-cache hits (the request skips decode, "
+               "transfer and the stage forward)"),
+    MetricSpec("pages.feature_inserts", "counter", "poll",
+               "feature entries written after a successful forward "
+               "(insert-after-success only)"),
+    MetricSpec("pages.feature_evictions", "counter", "poll",
+               "LRU feature entries evicted to fit an insert"),
+    MetricSpec("pages.feature_gathers", "counter", "poll",
+               "feature-arena gather kernels dispatched (one per "
+               "feature-hit emission)"),
+    MetricSpec("pages.feature_gather_rows", "counter", "poll",
+               "output rows gathered from feature pages"),
+    MetricSpec("pages.feature_bytes_saved", "counter", "poll",
+               "wire bytes feature hits did not ship host->device"),
+    MetricSpec("pages.live", "gauge", "poll",
+               "pages off the free lists (entry-held + limbo) across "
+               "arenas"),
+    MetricSpec("pages.limbo", "gauge", "poll",
+               "evicted-but-still-pinned pages awaiting unpin"),
+    MetricSpec("pages.bytes", "gauge", "poll",
+               "total arena slab bytes (the page_pool HBM claim)"),
     MetricSpec("staging.slots", "gauge", "poll",
                "allocated staging slots"),
     MetricSpec("handoff.d2d_edges", "counter", "poll",
@@ -593,6 +666,9 @@ METRIC_REGISTRY = (
                "staging-slot slab bytes as a ledger owner"),
     MetricSpec("memory.ragged_pool_bytes", "gauge", "poll",
                "ragged pool dispatch-shape bytes as a ledger owner"),
+    MetricSpec("memory.page_pool_bytes", "gauge", "poll",
+               "page-allocator arena slab + shared-pool bytes "
+               "(memledger page_pool owner, rnb_tpu.pager)"),
     MetricSpec("memory.handoff_bytes", "gauge", "poll",
                "bytes resident from the latest edge adoptions"),
     # -- the live SLO layer (derived inside the registry) -------------

@@ -74,6 +74,20 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["staging_" + key] = int(val)
+        elif line.startswith("Pages:"):
+            # "Pages: arenas=A pages=P page_rows=R live=L limbo=M
+            #  bytes=B allocs=.. frees=.. alloc_fails=.. gathers=..
+            #  gather_rows=.. feature_lookups=.. feature_hits=..
+            #  feature_inserts=.. feature_evictions=..
+            #  feature_gathers=.. feature_gather_rows=..
+            #  feature_bytes_saved=.. feature_entries=..
+            #  bypassed_batches=.." — paged device-memory ledger
+            # (rnb_tpu.pager), pager-enabled runs only; --check holds
+            # allocs == frees + live at teardown, feature_hits <=
+            # feature_lookups, gather_rows <= ragged cache_hit_rows
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["pages_" + key] = int(val)
         elif line.startswith("Autotune buckets:"):
             # JSON {row-bucket: emission count} — must be matched
             # before the "Autotune:" prefix below
@@ -863,6 +877,76 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
                 "(a realloc needs a confirmed staged transfer)"
                 % (meta["staging_reallocs"],
                    meta["staging_staged_batches"]))
+
+    # paged device-memory accounting (rnb_tpu.pager): the teardown
+    # page ledger must foot exactly — every allocated page is either
+    # freed or still live (entry-held/limbo) when the job ends; the
+    # feature cache can never hit more than it looked up, inserts
+    # split exactly into resident entries + evictions, a feature
+    # gather needs a feature hit that survived to the runner, and the
+    # clip-plane gather rows are a subset of the ragged cache hit
+    # rows (a shed hit releases its plan before any gather dispatch)
+    if "pages_allocs" in meta:
+        for key in ("pages_arenas", "pages_pages", "pages_page_rows",
+                    "pages_live", "pages_limbo", "pages_bytes",
+                    "pages_allocs", "pages_frees", "pages_alloc_fails",
+                    "pages_gathers", "pages_gather_rows",
+                    "pages_feature_lookups", "pages_feature_hits",
+                    "pages_feature_inserts", "pages_feature_evictions",
+                    "pages_feature_gathers",
+                    "pages_feature_gather_rows",
+                    "pages_feature_bytes_saved",
+                    "pages_feature_entries",
+                    "pages_bypassed_batches"):
+            if meta.get(key, 0) < 0:
+                problems.append("negative %s" % key)
+        allocs = meta.get("pages_allocs", 0)
+        frees = meta.get("pages_frees", 0)
+        live = meta.get("pages_live", 0)
+        if allocs != frees + live:
+            problems.append(
+                "pages_allocs=%d != pages_frees=%d + pages_live=%d "
+                "(a page leaked or was double-freed)"
+                % (allocs, frees, live))
+        if meta.get("pages_limbo", 0) > live:
+            problems.append(
+                "pages_limbo=%d exceeds pages_live=%d (limbo pages "
+                "are off the free list)"
+                % (meta["pages_limbo"], live))
+        if meta.get("pages_feature_hits", 0) \
+                > meta.get("pages_feature_lookups", 0):
+            problems.append(
+                "pages_feature_hits=%d exceeds "
+                "pages_feature_lookups=%d (every hit is a lookup)"
+                % (meta["pages_feature_hits"],
+                   meta["pages_feature_lookups"]))
+        if meta.get("pages_feature_inserts", 0) \
+                != meta.get("pages_feature_entries", 0) \
+                + meta.get("pages_feature_evictions", 0):
+            problems.append(
+                "pages_feature_inserts=%d != pages_feature_entries=%d "
+                "+ pages_feature_evictions=%d (entries leave only by "
+                "eviction)"
+                % (meta["pages_feature_inserts"],
+                   meta["pages_feature_entries"],
+                   meta["pages_feature_evictions"]))
+        if meta.get("pages_feature_gathers", 0) \
+                > meta.get("pages_feature_hits", 0):
+            problems.append(
+                "pages_feature_gathers=%d exceeds "
+                "pages_feature_hits=%d (a gather needs a hit plan; "
+                "shed hits release without gathering)"
+                % (meta["pages_feature_gathers"],
+                   meta["pages_feature_hits"]))
+        if "ragged_cache_hit_rows" in meta \
+                and meta.get("pages_gather_rows", 0) \
+                > meta.get("ragged_cache_hit_rows", 0):
+            problems.append(
+                "pages_gather_rows=%d exceeds ragged "
+                "cache_hit_rows=%d (gathered rows are the cache hit "
+                "rows that survived to dispatch)"
+                % (meta["pages_gather_rows"],
+                   meta["ragged_cache_hit_rows"]))
 
     # autotune accounting (rnb_tpu.autotune): every batched emission
     # under autotune is covered by a controller decision (forced drains

@@ -1,0 +1,24 @@
+"""RNB-H010: pool/bucket-shaped DEVICE allocation per emission."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_host(shape):
+    return shape
+
+
+class Stage:
+    def _batch_shape(self, rows):
+        return (rows, 8, 112, 112, 3)
+
+    def __call__(self, tensors, non_tensors, time_card):
+        # a fresh pool-shaped device array per emission (the HBM
+        # fragmentation the page allocator exists to delete)
+        pool = jnp.zeros(self._batch_shape(4), jnp.uint8)
+        return (pool,), non_tensors, time_card
+
+    def submit(self, video):
+        # the device_put spelling of the same bug
+        dev = jax.devices()[0]
+        return jax.device_put(make_host(self._batch_shape(8)), dev)

@@ -160,6 +160,7 @@ HOTPATH_CASES = [
     ("bad_h008_handoff.py", "RNB-H008"),
     ("bad_h009_block.py", "RNB-H009"),
     ("bad_h009_socket.py", "RNB-H009"),
+    ("bad_h010_device_alloc.py", "RNB-H010"),
 ]
 
 
@@ -183,6 +184,15 @@ def test_good_h009_socket_fixture_is_clean():
     # wire.recv_exact idiom), are the sanctioned shapes
     from rnb_tpu.analysis.hotpath import check_file
     assert check_file(_fixture("good_h009_socket.py"),
+                      root=FIXTURES) == []
+
+
+def test_good_h010_fixture_is_clean():
+    # pool-shaped device memory allocated once at stage init and
+    # reused per emission is the sanctioned shape; RNB-H010 must stay
+    # quiet on it
+    from rnb_tpu.analysis.hotpath import check_file
+    assert check_file(_fixture("good_h010_device_alloc.py"),
                       root=FIXTURES) == []
 
 
@@ -418,6 +428,7 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Stacks: samples=%d\\n" % st)\n'
                      'f.write("Net: frames_sent=%d\\n" % nt)\n'
                      'f.write("Net errors: total=%d\\n" % ne)\n'
+                     'f.write("Pages: allocs=%d\\n" % pg)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
     findings = check_meta_lines(str(bench), _parse_utils_src(),
                                 root=str(tmp_path))

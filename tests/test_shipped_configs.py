@@ -344,6 +344,71 @@ def test_netedge_arms_ship_executed_with_loopback_near_in_process():
         % ratio)
 
 
+def test_paged_zipf_arm_ships_executed_and_beats_its_blob_twin():
+    """The paged-memory headline pair (PR 17) must land in BOTH
+    configs/ and the matrix with ok execution rows, and the committed
+    rows must back the headline claim: the paged + feature-pages cell
+    >= 1.15x the blob-cache twin under the same seeded Zipf workload,
+    executed back-to-back by the same sweep. The twins cannot be
+    byte-identical pipelines — the pager requires the ragged plane by
+    construction — so the honesty anchor is the WORKLOAD: the same
+    popularity block, the same fusing shape, the same cache budget.
+    What the ratio then measures is the paged seam itself (page-slab
+    hits gathered on-device instead of host-copied blobs, plus
+    feature pages answering repeats before any decode). A re-sweep
+    that drops below the floor invalidates the headline and must fail
+    here (`make pages` asserts the numerics contract end-to-end)."""
+    rel = "configs/rnb-fused-yuv-paged-zipf.json"
+    base = "configs/rnb-fused-yuv-zipf-cache.json"
+    from rnb_tpu.config import load_config
+    for p in (rel, base):
+        assert os.path.exists(os.path.join(REPO, p)), p
+    cfg = load_config(os.path.join(REPO, rel))
+    assert cfg.pager is not None and cfg.pager.get("enabled")
+    assert cfg.pager.get("feature_cache"), (
+        "the headline cell must exercise feature pages — without them "
+        "the row only measures the clip-page gather")
+    assert cfg.ragged is not None and cfg.ragged.get("enabled"), (
+        "pager requires the ragged plane (page gathers land in the "
+        "ragged pool)")
+    base_cfg = load_config(os.path.join(REPO, base))
+    assert cfg.pager.get("page_rows", 0) >= 1
+    assert base_cfg.pager is None, (
+        "the blob twin must not enable the pager — the ratio stops "
+        "meaning 'the paged seam' otherwise")
+    # same seeded Zipf workload and the same cache budget on both
+    # arms: the only intended deltas are the pager + ragged planes
+    with open(os.path.join(REPO, rel)) as f:
+        rel_raw = json.load(f)
+    with open(os.path.join(REPO, base)) as f:
+        base_raw = json.load(f)
+    assert rel_raw["popularity"] == base_raw["popularity"], (
+        "the twins drifted apart on the popularity block — the ratio "
+        "is only honest over identical traffic")
+    rel_kw = cfg.steps[0].kwargs_for_group(0)
+    base_kw = base_cfg.steps[0].kwargs_for_group(0)
+    for key in ("cache_mb", "fuse", "max_clips", "pixel_path"):
+        assert rel_kw.get(key) == base_kw.get(key), (
+            "twins differ on loader %r — re-align the arms before "
+            "trusting the committed ratio" % key)
+    with open(ARTIFACT) as f:
+        rows = {r["config"]: r for r in json.load(f)["configs"]}
+    for p in (rel, base):
+        assert p in rows and rows[p].get("ok"), (
+            "%s has no ok execution row — run "
+            "scripts/run_shipped_configs.py --only '%s'"
+            % (p, os.path.basename(p)))
+    ratio = rows[rel]["videos_per_sec"] / rows[base]["videos_per_sec"]
+    assert ratio >= 1.15, (
+        "paged Zipf cell runs at %.2fx its blob-cache twin — the "
+        "headline floor is 1.15x (committed pair: 1.21x). Re-execute "
+        "BOTH rows back-to-back on one idle host "
+        "(scripts/run_shipped_configs.py --only "
+        "'rnb-fused-yuv-*zipf*') before concluding a regression; if "
+        "it reproduces, profile the gather path (`make pages`) "
+        "before touching the floor" % ratio)
+
+
 def test_every_executed_config_is_still_shipped():
     """The reverse direction: MULTICHIP_CONFIGS.json and configs/ stay
     in sync BOTH ways. A row for a config that no longer ships is a

@@ -174,13 +174,15 @@ def test_hostprof_totals_prefix_sum():
 def test_aggregate_snapshots_sums():
     agg = aggregate_snapshots([
         {"slots": 3, "slot_bytes": 10, "acquires": 5, "acquire_waits": 1,
-         "staged_batches": 4, "copied_batches": 1, "reallocs": 0},
+         "staged_batches": 4, "copied_batches": 1,
+         "bypassed_batches": 2, "reallocs": 0},
         {"slots": 2, "slot_bytes": 20, "acquires": 2, "acquire_waits": 0,
          "staged_batches": 1, "copied_batches": 0, "reallocs": 2},
     ])
     assert agg == {"slots": 5, "slot_bytes": 30, "acquires": 7,
                    "acquire_waits": 1, "staged_batches": 5,
-                   "copied_batches": 1, "reallocs": 2}
+                   "copied_batches": 1, "bypassed_batches": 2,
+                   "reallocs": 2}
 
 
 # -- golden parity: staged path vs seed copy path ---------------------
