@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: lint test native stamps trace ragged multichip chaos netchaos \
-	metrics dct devobs benchdiff explain operator pages
+	metrics dct devobs benchdiff explain operator pages races
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -131,6 +131,17 @@ operator:
 # green on both arms.
 pages:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/pages_demo.py
+
+# Lock-discipline gate (README "Concurrency contracts"): the shipped
+# chaos arm re-run with the runtime lock-order witness armed
+# (lint.lock_witness) — every core lock records its acquisition-order
+# edges — asserting zero witnessed violations (no inversion, no
+# release-without-hold, no *_locked breach), every observed edge
+# present in the static RNB-C lock-order graph, and the Locks: ledger
+# footing under parse_utils --check. Exit 0 = the declared
+# concurrency contracts hold under fire.
+races:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/races_demo.py
 
 native:
 	$(MAKE) -C native

@@ -267,7 +267,8 @@ COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Operator:": "operator_",
                          "Stacks:": "stacks_",
                          "Net:": "net_",
-                         "Net errors:": "net_err_"}
+                         "Net errors:": "net_err_",
+                         "Locks:": "locks_"}
 
 #: verbatim-named counter fields (prefix "") the reverse RNB-T006
 #: direction holds to a meta-line counter — the Faults: trio plus the
@@ -552,7 +553,8 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
                 or field.startswith("whatif_") \
                 or field.startswith("operator_") \
                 or field.startswith("stacks_") \
-                or field.startswith("net_"):
+                or field.startswith("net_") \
+                or field.startswith("locks_"):
             if field not in mapped:
                 findings.append(Finding(
                     "RNB-T006", rel, 0, field,

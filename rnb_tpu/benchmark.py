@@ -365,6 +365,17 @@ class BenchmarkResult:
     net_err_timeout: int = 0
     net_err_partial_frame: int = 0
     net_err_corrupt: int = 0
+    #: lock-order witness ledger (rnb_tpu.lockwitness, root `lint`
+    #: config key with lock_witness true): witnessed locks, total
+    #: acquisitions, distinct acquisition-order edges, discipline
+    #: violations — all zero without the key. --check holds
+    #: locks_violations to zero and the Lock edges: JSON detail to
+    #: the static RNB-C lock-order graph (observed subset-of
+    #: declared).
+    locks_tracked: int = 0
+    locks_acquires: int = 0
+    locks_edges: int = 0
+    locks_violations: int = 0
 
 
 def run_benchmark(config_path: str,
@@ -413,6 +424,18 @@ def run_benchmark(config_path: str,
     from rnb_tpu.devices import probe_busy_devices
     for warning in probe_busy_devices(config.all_devices()):
         print("[rnb-tpu] WARNING: %s" % warning, file=sys.stderr)
+
+    # lock-order witness (rnb_tpu.lockwitness, root `lint` config
+    # key): armed BEFORE pipeline construction — the witness wraps
+    # locks at lockwitness.lock() creation time, so enabling it after
+    # the cache/pager/staging/health objects exist would observe
+    # nothing
+    from rnb_tpu import lockwitness
+    witness_armed = bool(config.lint
+                         and config.lint.get("lock_witness", False))
+    if witness_armed:
+        lockwitness.enable()
+        lockwitness.reset()
 
     if job_id is None:
         job_id = "%s-mi%d-b%d-v%d-qs%d" % (
@@ -1246,6 +1269,11 @@ def run_benchmark(config_path: str,
                      if deadline_stats is not None else None)
     net_snap = (netedge_stats.snapshot()
                 if netedge_stats is not None else None)
+    # final witness ledger: every pipeline thread joined above, so the
+    # edge set and violation list are settled (config-armed runs only
+    # — an externally enabled witness, e.g. the test harness, keeps
+    # un-armed runs' logs byte-stable)
+    lock_snap = lockwitness.summary() if witness_armed else None
     hedge_stats = None
     if governors_by_step:
         from rnb_tpu.health import aggregate_hedge_snapshots
@@ -1656,6 +1684,17 @@ def run_benchmark(config_path: str,
                        net_snap["err_reset"], net_snap["err_timeout"],
                        net_snap["err_partial_frame"],
                        net_snap["err_corrupt"]))
+        if lock_snap is not None:
+            # witness-armed runs only; --check holds violations to
+            # zero, the Lock edges: detail to these counts, and every
+            # observed edge to the static RNB-C lock-order graph
+            f.write("Locks: tracked=%d acquires=%d edges=%d "
+                    "violations=%d\n"
+                    % (lock_snap["locks"], lock_snap["acquires"],
+                       len(lock_snap["edges"]),
+                       len(lock_snap["violations"])))
+            f.write("Lock edges: %s\n"
+                    % lockwitness.format_edges(lock_snap))
     if faults["dead_letters"]:
         # the controller's dead-letter record: one line per contained
         # failure (detail capped at FaultStats.MAX_DEAD_LETTERS; the
@@ -1796,6 +1835,12 @@ def run_benchmark(config_path: str,
                  net_snap["resends"], net_snap["reconnects"],
                  net_snap["remote"], net_snap["local"],
                  net_snap["err_total"]))
+    if lock_snap is not None and print_progress:
+        print("Locks: %d witnessed lock(s), %d acquisition(s), "
+              "%d order edge(s), %d violation(s)"
+              % (lock_snap["locks"], lock_snap["acquires"],
+                 len(lock_snap["edges"]),
+                 len(lock_snap["violations"])))
     if ragged_stats is not None and print_progress:
         print("Ragged: %d emission(s), %d valid row(s) at pool_rows=%d"
               ", %d pad row(s) eliminated vs the bucketed rule, "
@@ -2080,6 +2125,11 @@ def run_benchmark(config_path: str,
                              if net_snap else 0),
         net_open_before_timeout=(net_snap["open_before_timeout"]
                                  if net_snap else 0),
+        locks_tracked=(lock_snap["locks"] if lock_snap else 0),
+        locks_acquires=(lock_snap["acquires"] if lock_snap else 0),
+        locks_edges=(len(lock_snap["edges"]) if lock_snap else 0),
+        locks_violations=(len(lock_snap["violations"])
+                          if lock_snap else 0),
         net_err_total=(net_snap["err_total"] if net_snap else 0),
         net_err_refused=(net_snap["err_refused"] if net_snap else 0),
         net_err_reset=(net_snap["err_reset"] if net_snap else 0),

@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from rnb_tpu import lockwitness
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 
 #: stat signature for ids that are not files on disk (synth:// ids):
@@ -111,6 +112,21 @@ class PagedEntry:
 class ClipCache:
     """Bounded, byte-accounted LRU of device-resident clip batches."""
 
+    #: declared concurrency contract (rnb-lint RNB-C001/C003): which
+    #: lock guards which cross-thread attribute
+    GUARDED_BY = {
+        "_entries": "_lock",
+        "_arena": "_lock",
+        "capacity_bytes": "_lock",
+        "resident_bytes": "_lock",
+        "num_hits": "_lock",
+        "num_misses": "_lock",
+        "num_inserts": "_lock",
+        "num_evictions": "_lock",
+        "num_coalesced": "_lock",
+        "num_oversize": "_lock",
+    }
+
     def __init__(self, cache_mb: float, device=None):
         if cache_mb <= 0:
             raise ValueError("cache_mb must be > 0 to build a ClipCache "
@@ -118,7 +134,7 @@ class ClipCache:
                              % (cache_mb,))
         self.capacity_bytes = int(float(cache_mb) * (1 << 20))
         self.device = device
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("ClipCache._lock")
         self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
         self.resident_bytes = 0
         # exact counters, surfaced end-to-end (benchmark/log-meta/parse)
@@ -148,7 +164,8 @@ class ClipCache:
 
     @property
     def paged(self) -> bool:
-        return self._arena is not None
+        with self._lock:
+            return self._arena is not None
 
     def acquire(self, key: tuple):
         """Paged-mode hit path: counted lookup -> pinned
@@ -157,10 +174,10 @@ class ClipCache:
         the consumption seam and releases the plan once its gather
         dispatched; pages evicted in between park in limbo, so the
         plan's rows can never be recycled under it."""
-        arena = self._arena
-        assert arena is not None, "acquire() is the paged hit path"
         from rnb_tpu.pager import GatherPlan
         with self._lock:
+            arena = self._arena
+            assert arena is not None, "acquire() is the paged hit path"
             entry = self._entries.get(key)
             if entry is None:
                 self.num_misses += 1
@@ -183,12 +200,13 @@ class ClipCache:
         entry needing more pages than the whole arena holds is counted
         ``oversize`` and skipped — the only size an entry can still
         exceed, since pages need not be contiguous."""
-        arena = self._arena
-        assert arena is not None, "insert_pages() is the paged insert"
         valid = int(valid)
         if valid < 1:
             return False
         with self._lock:
+            arena = self._arena
+            assert arena is not None, \
+                "insert_pages() is the paged insert"
             if key in self._entries:
                 return False
             needed = arena.pages_needed(valid)
@@ -292,11 +310,13 @@ class ClipCache:
         independent bytes and can never observe a slot reuse.
         """
         dtype = np.dtype(dtype)
-        if int(np.prod(target_shape)) * dtype.itemsize \
-                > self.capacity_bytes:
-            with self._lock:
+        with self._lock:
+            # capacity_bytes is rebound by attach_arena — read it
+            # under the same lock that guards the switch
+            if int(np.prod(target_shape)) * dtype.itemsize \
+                    > self.capacity_bytes:
                 self.num_oversize += 1
-            return False
+                return False
         if self.contains(key):
             return False
         jax, _ = _jax_numpy()
@@ -365,8 +385,10 @@ class InflightTable:
     landed by then) or decodes afresh.
     """
 
+    GUARDED_BY = {"_records": "_lock"}
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("InflightTable._lock")
         self._records: Dict[tuple, Any] = {}
 
     def get(self, key: tuple) -> Optional[Any]:

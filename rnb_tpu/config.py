@@ -40,6 +40,7 @@ ROOT_KEYWORDS = [
     "trace", "ragged", "pager", "handoff", "placement", "health",
     "deadline",
     "metrics", "devobs", "critpath", "whatif", "operator", "netedge",
+    "lint",
     "_comment",
 ]
 
@@ -93,6 +94,10 @@ WHATIF_KEYWORDS = ["enabled"]
 
 #: keys a root 'operator' object may carry (rnb_tpu.statusz)
 OPERATOR_KEYWORDS = ["enabled", "port", "allow_actions", "sample_hz"]
+
+#: keys a root 'lint' object may carry (runtime arms of the
+#: rnb-lint analyzers; today just the RNB-C lock-order witness)
+LINT_KEYWORDS = ["lock_witness"]
 
 #: keys a root 'netedge' object may carry (rnb_tpu.netedge)
 NETEDGE_KEYWORDS = ["enabled", "listen", "connect", "beat_ms",
@@ -316,6 +321,15 @@ class PipelineConfig:
     #: log-meta gains the Net:/Net errors: lines. Absent => in-process
     #: queues, byte-stable logs.
     netedge: Optional[Dict[str, Any]] = None
+    #: validated lint-runtime spec ({"lock_witness": ..}), or None;
+    #: with lock_witness true the launcher enables the
+    #: rnb_tpu.lockwitness lock-order witness BEFORE pipeline
+    #: construction (the witness wraps locks at creation), log-meta
+    #: gains the Locks:/Lock edges: lines, and parse --check holds
+    #: observed acquisition-order edges to a subset of the static
+    #: RNB-C lock-order graph with zero violations. Absent or false
+    #: => plain threading locks, byte-stable logs.
+    lint: Optional[Dict[str, Any]] = None
     #: validated tracing spec ({"enabled": .., "sample_hz": ..,
     #: "max_events": ..}), or None; when enabled the launcher builds
     #: an rnb_tpu.trace.Tracer, every thread role emits named spans,
@@ -929,6 +943,16 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
             except ValueError as e:
                 raise ConfigError("invalid 'netedge': %s" % e) from e
 
+    lint = raw.get("lint")
+    if lint is not None:
+        _expect(isinstance(lint, dict), "'lint' must be an object")
+        unknown_lint = sorted(set(lint) - set(LINT_KEYWORDS))
+        _expect(not unknown_lint,
+                "'lint' has unknown key(s) %s — keys are %s"
+                % (unknown_lint, LINT_KEYWORDS))
+        _expect(isinstance(lint.get("lock_witness", False), bool),
+                "'lint.lock_witness' must be a boolean")
+
     fault_plan = raw.get("fault_plan")
     if fault_plan is not None:
         from rnb_tpu.faults import FaultPlan
@@ -1173,4 +1197,5 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
                           devobs=devobs,
                           operator=operator,
                           netedge=netedge,
+                          lint=lint,
                           trace=trace)

@@ -67,6 +67,11 @@ class TerminationState:
     recorded reason is deterministic.
     """
 
+    UNGUARDED_OK = {
+        "_value": "first-writer-wins under _lock; bare reads observe "
+                  "a monotone raise-once flag",
+    }
+
     def __init__(self):
         self._value = TerminationFlag.UNSET
         self._lock = threading.Lock()
@@ -100,6 +105,16 @@ class FaultStats:
     """
 
     MAX_DEAD_LETTERS = 1000
+
+    GUARDED_BY = {
+        "num_failed": "_lock",
+        "num_shed": "_lock",
+        "num_retries": "_lock",
+        "failure_reasons": "_lock",
+        "shed_sites": "_lock",
+        "overflow_sites": "_lock",
+        "dead_letters": "_lock",
+    }
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -170,6 +185,11 @@ class InferenceCounter:
     will never owe further work on counts toward the target, so a run
     with contained failures still terminates instead of waiting forever
     for completions that cannot come."""
+
+    UNGUARDED_OK = {
+        "_value": "add() is atomic under _lock; bare value reads are "
+                  "a progress gauge",
+    }
 
     def __init__(self):
         self._value = 0
@@ -252,6 +272,8 @@ class EdgeTracker:
     only the *last* one enqueues the markers — by then every real item
     is already in the queue ahead of them.
     """
+
+    GUARDED_BY = {"_remaining": "_lock"}
 
     def __init__(self, num_producers: int, num_markers: int):
         self._remaining = num_producers

@@ -170,6 +170,13 @@ class DecodePool:
     (reference README.md:46-110).
     """
 
+    GUARDED_BY = {"_pending": "_pending_lock"}
+
+    UNGUARDED_OK = {
+        "_pool": "set in __init__, cleared only by close() at "
+                 "teardown after in-flight tickets drain",
+    }
+
     def __init__(self, num_threads: Optional[int] = None):
         lib = load_native()
         if lib is None:

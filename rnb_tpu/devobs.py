@@ -225,6 +225,12 @@ class StageComputeMeter:
                  "bytes_per_row", "_lock", "rows", "dispatches",
                  "busy_s")
 
+    GUARDED_BY = {
+        "rows": "_lock",
+        "dispatches": "_lock",
+        "busy_s": "_lock",
+    }
+
     def __init__(self, step_idx: int, flops_per_row: int,
                  devices: int = 1,
                  bytes_per_row: Optional[float] = None):
@@ -299,6 +305,22 @@ class DevObsPlane:
     """Per-job device observability: capture worker + compute meters +
     the memory ledger. Built by rnb_tpu.benchmark when the ``devobs``
     root config key is enabled; one instance per job."""
+
+    GUARDED_BY = {
+        "meters": "_lock",
+        "captures": "_lock",
+        "captures_skipped": "_lock",
+        "_capture_requests": "_lock",
+        "_captures_inflight": "_lock",
+    }
+
+    UNGUARDED_OK = {
+        "_worker": "controller-thread lifecycle (start/stop)",
+        "_peak_tflops": "idempotent memo — a racing duplicate probe "
+                        "computes the same value",
+        "_peak_resolved": "guards only the memo above; same "
+                          "idempotence argument",
+    }
 
     def __init__(self, settings: DevObsSettings,
                  job_dir: Optional[str] = None, job_id: str = ""):

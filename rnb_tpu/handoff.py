@@ -273,6 +273,8 @@ class InflightDepths:
     ``queue.qsize()`` poll would miss.
     """
 
+    GUARDED_BY = {"_depths": "_lock"}
+
     def __init__(self, queue_indices):
         self._lock = threading.Lock()
         self._depths: Dict[int, int] = {int(q): 0

@@ -57,7 +57,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
-from rnb_tpu import metrics, trace
+from rnb_tpu import lockwitness, metrics, trace
 
 # -- lane states -------------------------------------------------------
 
@@ -169,9 +169,19 @@ class LaneHealthBoard:
     #: hundreds of milliseconds
     EVAL_INTERVAL_S = 0.02
 
+    #: declared concurrency contract (rnb-lint RNB-C001/C003)
+    GUARDED_BY = {
+        "_lanes": "_lock",
+        "_last_eval": "_lock",
+        "num_transitions": "_lock",
+        "num_opens": "_lock",
+        "num_evictions": "_lock",
+        "num_probes": "_lock",
+    }
+
     def __init__(self, queue_indices, settings: HealthSettings):
         self.settings = settings
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("LaneHealthBoard._lock")
         now = time.monotonic()
         self._last_eval = float("-inf")
         self._lanes: "OrderedDict[int, _Lane]" = OrderedDict(
@@ -220,7 +230,7 @@ class LaneHealthBoard:
                 lane.inflight.popleft()
             if lane.state == HALF_OPEN and lane.probe_outstanding:
                 lane.probe_outstanding = False
-                self._transition(queue_idx, lane, HEALTHY,
+                self._transition_locked(queue_idx, lane, HEALTHY,
                                  "probe-settled")
 
     def note_failure(self, queue_idx: int) -> None:
@@ -237,7 +247,7 @@ class LaneHealthBoard:
         with self._lock:
             lane = self._lanes.get(queue_idx)
             if lane is not None and lane.state != EVICTED:
-                self._transition(queue_idx, lane, EVICTED, reason)
+                self._transition_locked(queue_idx, lane, EVICTED, reason)
                 self.num_evictions += 1
 
     def note_redispatch(self, from_queue_idx: int, n: int = 1) -> None:
@@ -295,7 +305,7 @@ class LaneHealthBoard:
 
     # -- the state machine --------------------------------------------
 
-    def _transition(self, queue_idx: int, lane: _Lane, to: str,
+    def _transition_locked(self, queue_idx: int, lane: _Lane, to: str,
                     why: str, now: Optional[float] = None) -> None:
         # lock held by caller; `now` keeps the transition clock in the
         # caller's timeline (unit tests drive it explicitly)
@@ -343,13 +353,13 @@ class LaneHealthBoard:
             failing = lane.failures >= FAILURE_TRIP_THRESHOLD
             if lane.state == HEALTHY:
                 if distress > s.suspect_after_ms or failing:
-                    self._transition(
+                    self._transition_locked(
                         queue_idx, lane, SUSPECT,
                         "failures %d" % lane.failures if failing
                         else "distress %.0fms" % distress, now)
             elif lane.state == SUSPECT:
                 if distress > s.open_after_ms or failing:
-                    self._transition(
+                    self._transition_locked(
                         queue_idx, lane, OPEN,
                         "failures %d" % lane.failures if failing
                         else "distress %.0fms" % distress, now)
@@ -364,17 +374,17 @@ class LaneHealthBoard:
                     # low-distress the instant it transitions, and
                     # dwell-free healing would flap
                     # healthy<->suspect forever
-                    self._transition(queue_idx, lane, HEALTHY,
+                    self._transition_locked(queue_idx, lane, HEALTHY,
                                      "recovered", now)
             elif lane.state == OPEN:
                 if (now - lane.since) * 1000.0 >= s.probe_interval_ms:
-                    self._transition(queue_idx, lane, HALF_OPEN,
+                    self._transition_locked(queue_idx, lane, HALF_OPEN,
                                      "probe-due", now)
             elif lane.state == HALF_OPEN:
                 if lane.probe_outstanding and \
                         (now - lane.probe_t) * 1000.0 > s.open_after_ms:
                     lane.probe_outstanding = False
-                    self._transition(queue_idx, lane, OPEN,
+                    self._transition_locked(queue_idx, lane, OPEN,
                                      "probe-aged-out", now)
 
     def state(self, queue_idx: int) -> Optional[str]:
@@ -565,8 +575,10 @@ class DeadlineStats:
     silent drift.
     """
 
+    GUARDED_BY = {"expired": "_lock", "sites": "_lock"}
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("DeadlineStats._lock")
         self.expired = 0
         self.sites: Dict[str, int] = {}
 
@@ -706,12 +718,28 @@ class HedgeGovernor:
     P95X_MIN_SAMPLES = 5
     P95X_MIN_MS = 1.0
 
+    #: declared concurrency contract (rnb-lint RNB-C001/C003); mode /
+    #: static_ms / ewma_alpha are immutable after __init__ and so
+    #: outside the contract by convention
+    GUARDED_BY = {
+        "_outstanding": "_lock",
+        "_unresolved": "_lock",
+        "_resolved": "_lock",
+        "_lat_mean_ms": "_lock",
+        "_lat_sq_ms": "_lock",
+        "_samples": "_lock",
+        "fired": "_lock",
+        "won": "_lock",
+        "lost": "_lock",
+        "wasted_ms": "_lock",
+    }
+
     def __init__(self, hedge_ms, ewma_alpha: float = 0.2):
         self.mode = "p95x" if hedge_ms == "p95x" else "static"
         self.static_ms = (float(hedge_ms) if self.mode == "static"
                           else 0.0)
         self.ewma_alpha = float(ewma_alpha)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("HedgeGovernor._lock")
         self._outstanding: "OrderedDict[tuple, _Outstanding]" = \
             OrderedDict()
         #: hedged keys awaiting their FIRST resolution (either copy)

@@ -115,6 +115,16 @@ class MemLedger:
     (rnb_tpu.devobs); sampled by the devobs worker and by metrics
     flusher polls."""
 
+    GUARDED_BY = {
+        "_sources": "_lock",
+        "_above_watermark": "_lock",
+        "_last": "_lock",
+        "_peak_by_owner": "_lock",
+        "num_samples": "_lock",
+        "peak_total": "_lock",
+        "watermark_hits": "_lock",
+    }
+
     def __init__(self, watermark_bytes: Optional[int] = None):
         self._lock = threading.Lock()
         #: (owner, key) -> _Source; the key dedupes shared objects

@@ -405,6 +405,34 @@ class MetricsRegistry:
     same lock and does file IO outside it.
     """
 
+    GUARDED_BY = {
+        "_counters": "_lock",
+        "_gauges": "_lock",
+        "_rates": "_lock",
+        "_hists": "_lock",
+        "_polled_counters": "_lock",
+        "_overflowed": "_lock",
+        "_fired_triggers": "_lock",
+        "_pending_dumps": "_lock",
+        "_last_dump_t": "_lock",
+        "slo_tracked": "_lock",
+        "slo_within": "_lock",
+        "slo_missed": "_lock",
+        "burn_max": "_lock",
+        "num_triggers": "_lock",
+        "seq": "_lock",
+    }
+
+    UNGUARDED_OK = {
+        "_name_kind": "declared-kind memo; racing writers insert "
+                      "identical values (the patterns are static)",
+        "num_dumps": "written only by the flusher's dump path; other "
+                     "threads' bare int reads gate a budget heuristic",
+        "_jsonl": "flusher-thread confined after start(); start/stop "
+                  "are the controller's lifecycle edges",
+        "_flusher": "controller-thread lifecycle (start/stop)",
+    }
+
     def __init__(self, settings: Optional[MetricsSettings] = None,
                  job_dir: Optional[str] = None, job_id: str = "",
                  slo_budget_ms: Optional[float] = None,
@@ -478,7 +506,7 @@ class MetricsRegistry:
                 "kind) or fix the call site" % metric_name)
         return kind
 
-    def _admit(self, store: dict, metric_name: str) -> bool:
+    def _admit_locked(self, store: dict, metric_name: str) -> bool:
         # series-cardinality bound: beyond MAX_SERIES total series the
         # registry counts the overflow instead of growing — a label
         # explosion degrades the telemetry, never the host
@@ -496,20 +524,20 @@ class MetricsRegistry:
     def inc_counter(self, metric_name: str, n: int = 1) -> None:
         self._kind_of(metric_name)
         with self._lock:
-            if self._admit(self._counters, metric_name):
+            if self._admit_locked(self._counters, metric_name):
                 self._counters[metric_name] = \
                     self._counters.get(metric_name, 0) + int(n)
 
     def set_gauge(self, metric_name: str, value) -> None:
         self._kind_of(metric_name)
         with self._lock:
-            if self._admit(self._gauges, metric_name):
+            if self._admit_locked(self._gauges, metric_name):
                 self._gauges[metric_name] = float(value)
 
     def observe_ms(self, metric_name: str, ms: float) -> None:
         self._kind_of(metric_name)
         with self._lock:
-            if self._admit(self._hists, metric_name):
+            if self._admit_locked(self._hists, metric_name):
                 hist = self._hists.get(metric_name)
                 if hist is None:
                     hist = self._hists[metric_name] = _Hist()
@@ -520,7 +548,7 @@ class MetricsRegistry:
         self._kind_of(metric_name)
         now = time.time() if now is None else now
         with self._lock:
-            if self._admit(self._rates, metric_name):
+            if self._admit_locked(self._rates, metric_name):
                 rate = self._rates.get(metric_name)
                 if rate is None:
                     rate = self._rates[metric_name] = _Rate()
@@ -548,14 +576,14 @@ class MetricsRegistry:
             self._name_kind[event_name] = kind or "undeclared"
         if kind == "histogram" and ph == "X":
             with self._lock:
-                if self._admit(self._hists, event_name):
+                if self._admit_locked(self._hists, event_name):
                     hist = self._hists.get(event_name)
                     if hist is None:
                         hist = self._hists[event_name] = _Hist()
                     hist.add(max(0.0, dur) * 1000.0)
         elif kind == "counter" and ph == "i":
             with self._lock:
-                if self._admit(self._counters, event_name):
+                if self._admit_locked(self._counters, event_name):
                     self._counters[event_name] = \
                         self._counters.get(event_name, 0) + 1
 
@@ -611,13 +639,13 @@ class MetricsRegistry:
             self.slo_tracked += tracked
             self.slo_within += within
             self.slo_missed += missed
-            if self._admit(self._rates, "slo.good"):
+            if self._admit_locked(self._rates, "slo.good"):
                 rate = self._rates.get("slo.good")
                 if rate is None:
                     rate = self._rates["slo.good"] = _Rate()
                 if within:
                     rate.add(within, now)
-            if missed and self._admit(self._rates, "slo.miss"):
+            if missed and self._admit_locked(self._rates, "slo.miss"):
                 rate = self._rates.get("slo.miss")
                 if rate is None:
                     rate = self._rates["slo.miss"] = _Rate()

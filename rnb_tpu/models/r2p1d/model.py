@@ -1457,6 +1457,22 @@ class R2P1DFusingLoader(R2P1DLoader):
     #: cannot stall planning (double/triple buffering)
     DEFAULT_STAGING_SLOTS = 3
 
+    GUARDED_BY = {"_out_ready": "_out_lock"}
+
+    UNGUARDED_OK = {
+        "_ready": "executor-thread confined; only the _out_ready "
+                  "handoff crosses the transfer-worker boundary",
+        "_inflight": "executor-thread confined (see _ready)",
+        "_open_slot": "executor-thread confined (see _ready)",
+        "_open_rows": "executor-thread confined (see _ready)",
+        "_open_count": "executor-thread confined (see _ready)",
+        "_failed": "executor-thread confined (see _ready)",
+        "_stage_retries": "executor-thread confined (see _ready)",
+        "_deadline_shed": "executor-thread confined (see _ready)",
+        "autotune": "executor-thread confined (see _ready)",
+        "ragged_stats": "executor-thread confined (see _ready)",
+    }
+
     def __init__(self, device, fuse: int = 6, depth: Optional[int] = None,
                  max_hold_ms: float = 5.0, **kwargs):
         if kwargs.get("prefetch"):

@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from rnb_tpu import lockwitness
 from rnb_tpu.control import dispose_requests
 from rnb_tpu.faults import (NetCorruptFrameError, NetPartialFrameError,
                             NetRefusedError, NetResetError,
@@ -198,8 +199,16 @@ class NetStats:
                   "net_partial_frame": "err_partial_frame",
                   "net_corrupt": "err_corrupt"}
 
+    #: declared concurrency contract (rnb-lint RNB-C001/C003)
+    GUARDED_BY = {
+        "_c": "_lock",
+        "peer_depth": "_lock",
+        "_t_first_open": "_lock",
+        "_t_first_timeout": "_lock",
+    }
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("NetStats._lock")
         self._c: Dict[str, int] = {k: 0 for k in self.COUNTERS}
         self.peer_depth = 0.0
         self._t_first_open: Optional[float] = None
@@ -267,6 +276,26 @@ class NetEdgeClient:
     into step-0 output-queue items. Neither joins the pipeline
     barriers — the edge is a transport, not a stage."""
 
+    #: declared concurrency contract (rnb-lint RNB-C001/C003): three
+    #: locks, three planes — socket handoff, resend window, receiver
+    #: pad re-count
+    GUARDED_BY = {
+        "_sock": "_send_lock",
+        "_window": "_wlock",
+        "_seq_next": "_wlock",
+        "_finalizing": "_wlock",
+        "_pad": "_pad_lock",
+    }
+    UNGUARDED_OK = {
+        "_dial_count": "tx thread is the sole dialer",
+        "_ever_connected": "tx-thread confined (dial path only)",
+        "_fired": "tx-thread confined (dial path only)",
+        "_eos_sent": "tx-thread confined (EOS drain runs on tx)",
+        "_evicted": "written only by the tx dial path; other "
+                    "threads' bare bool reads are monotone "
+                    "(evicted never un-evicts)",
+    }
+
     def __init__(self, settings: NetEdgeSettings, *, board, stats,
                  fault_plan, fault_stats, deadline_stats, counter,
                  num_videos, termination, filename_queue, local_queue,
@@ -290,7 +319,7 @@ class NetEdgeClient:
         self._addr = parse_addr(settings.connect)
         # -- connection (tx thread is the sole dialer) ----------------
         self._sock: Optional[socket.socket] = None
-        self._send_lock = threading.Lock()
+        self._send_lock = lockwitness.lock("NetEdgeClient._send_lock")
         self._connected = threading.Event()
         self._ever_connected = False
         self._dial_count = 0
@@ -300,7 +329,7 @@ class NetEdgeClient:
         #: protocol's clean goodbye, not a net_reset to count
         self._eos_sent = False
         # -- resend window --------------------------------------------
-        self._wlock = threading.Lock()
+        self._wlock = lockwitness.lock("NetEdgeClient._wlock")
         self._window: "OrderedDict[int, _WindowEntry]" = OrderedDict()
         self._seq_next = 1
         self._resend_due = threading.Event()
@@ -312,7 +341,7 @@ class NetEdgeClient:
         # loader's pad_rows stamps but the peer's PadCounter dies with
         # the peer, so the receiver re-counts shipped emissions here
         # and the launcher appends it to the job's pad sink
-        self._pad_lock = threading.Lock()
+        self._pad_lock = lockwitness.lock("NetEdgeClient._pad_lock")
         self._pad = {"pad_rows": 0, "total_rows": 0, "emissions": 0}
         self._stop = threading.Event()
         self._tx = threading.Thread(target=self._tx_loop,
@@ -668,7 +697,11 @@ class NetEdgeClient:
 
     def _rx_loop(self) -> None:
         while not self._stop.is_set():
-            sock = self._sock
+            # the tx thread swaps _sock on every reconnect — take the
+            # same lock that guards the swap, or this loop can read a
+            # half-published reference mid-redial
+            with self._send_lock:
+                sock = self._sock
             if sock is None:
                 if self._evicted:
                     return
@@ -812,6 +845,20 @@ class NetEdgePeer:
     wire. One connection at a time (the edge has one sender); a beat
     thread keeps liveness flowing while the model runs."""
 
+    GUARDED_BY = {"_conn": "_send_lock"}
+    UNGUARDED_OK = {
+        "_ledger": "serve-thread confined",
+        "_fired": "serve-thread confined",
+        "_depth": "written by the serve thread; the beat thread's "
+                  "bare int read is a depth gauge (staleness shows "
+                  "up as one conservative beat)",
+        "_wedge_until": "written by the serve thread; the beat "
+                        "thread reads a float gate (worst case one "
+                        "extra beat before wedging)",
+        "model": "published by build_model before the listener binds "
+                 "and the beat thread starts",
+    }
+
     def __init__(self, config, listen: str, seed: int = 0):
         from rnb_tpu.faults import FaultPlan
         self.config = config
@@ -826,7 +873,7 @@ class NetEdgePeer:
         self._ledger: "OrderedDict[int, tuple]" = OrderedDict()
         self._depth = 0
         self._wedge_until = 0.0
-        self._send_lock = threading.Lock()
+        self._send_lock = lockwitness.lock("NetEdgePeer._send_lock")
         self._conn: Optional[socket.socket] = None
         self._beat_stop = threading.Event()
         self.model = None
@@ -1010,7 +1057,12 @@ class NetEdgePeer:
 
     def _serve_conn(self, conn) -> bool:
         """One connection until EOS (-> True) or it dies (-> False)."""
-        self._conn = conn
+        # published under the send lock: a previous connection's beat
+        # thread may still be draining through _send — it must observe
+        # either the old (dead) socket or the new one, never a torn
+        # handoff
+        with self._send_lock:
+            self._conn = conn
         self._beat_stop.clear()
         beat = threading.Thread(target=self._beat_loop,
                                 name="netedge-beat", daemon=True)

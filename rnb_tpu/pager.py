@@ -63,7 +63,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from rnb_tpu import memledger
+from rnb_tpu import lockwitness, memledger
 
 #: fallback arena budget when neither ``pool_mb`` nor a cache-derived
 #: size hint exists (a bare pager on a cache-less config)
@@ -130,6 +130,17 @@ class Arena:
     rnb_tpu.ops.pages — in place, never copied — and read through
     functional gathers, so readers always observe a consistent value.
     """
+
+    #: declared concurrency contract (rnb-lint RNB-C001): the arena
+    #: has no lock of its own — every mutable field is guarded by the
+    #: owning pager's shared lock (hit plans build on executor threads
+    #: while inserts run on transfer workers)
+    GUARDED_BY = {
+        "_free": "pager.lock",
+        "_pins": "pager.lock",
+        "_limbo": "pager.lock",
+        "_slab": "pager.lock",
+    }
 
     def __init__(self, pager: "Pager", name: str,
                  row_shape: Tuple[int, ...], dtype,
@@ -301,6 +312,12 @@ class FeatureCache:
     fits.
     """
 
+    GUARDED_BY = {
+        "_arena": "pager.lock",
+        "_fingerprint": "pager.lock",
+        "_entries": "pager.lock",
+    }
+
     def __init__(self, pager: "Pager"):
         self.pager = pager
         self._arena: Optional[Arena] = None
@@ -318,7 +335,10 @@ class FeatureCache:
 
     @property
     def ready(self) -> bool:
-        return self._arena is not None
+        # _arena is published by attach() under the pager lock; the
+        # loader probes from its own threads, so the read pairs with it
+        with self.pager.lock:
+            return self._arena is not None
 
     def __len__(self) -> int:
         with self.pager.lock:
@@ -396,9 +416,16 @@ class Pager:
                     "feature_gathers", "feature_gather_rows",
                     "feature_bytes_saved")
 
+    GUARDED_BY = {
+        "counters": "lock",
+        "_arenas": "lock",
+        "_size_hint_bytes": "lock",
+        "_owned_ids": "lock",
+    }
+
     def __init__(self, settings: PagerSettings):
         self.settings = settings
-        self.lock = threading.RLock()
+        self.lock = lockwitness.lock("Pager.lock", threading.RLock)
         self.counters: Dict[str, int] = {k: 0
                                          for k in self.COUNTER_KEYS}
         self._arenas: List[Arena] = []

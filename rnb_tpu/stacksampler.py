@@ -103,6 +103,18 @@ class StackSampler:
     pairs), or inject ``frames_fn``/``names_fn``.
     """
 
+    GUARDED_BY = {
+        "_folded": "_lock",
+        "_roles": "_lock",
+        "_timeline": "_lock",
+        "samples": "_lock",
+        "timeline_dropped": "_lock",
+    }
+
+    UNGUARDED_OK = {
+        "_thread": "controller-thread lifecycle (start/stop)",
+    }
+
     def __init__(self, sample_hz: float = DEFAULT_SAMPLE_HZ,
                  frames_fn: Optional[Callable[[], Dict]] = None,
                  names_fn: Optional[Callable[[], Dict[int, str]]] = None):

@@ -53,7 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from rnb_tpu import trace
+from rnb_tpu import lockwitness, trace
 from rnb_tpu.utils.lazy_jax import jax_numpy as _jax_numpy
 
 #: slot lifecycle states (kept as strings for cheap introspection)
@@ -115,13 +115,27 @@ class StagingPool:
     silently hang the executor.
     """
 
+    #: declared concurrency contract (rnb-lint RNB-C001/C003); the
+    #: ``_available`` Condition is built ON ``_lock``, so holding
+    #: either is the same critical section
+    GUARDED_BY = {
+        "_slots": "_lock",
+        "_error": "_lock",
+        "num_acquires": "_lock",
+        "num_acquire_waits": "_lock",
+        "num_staged_batches": "_lock",
+        "num_copied_batches": "_lock",
+        "num_bypassed_batches": "_lock",
+        "num_reallocs": "_lock",
+    }
+
     def __init__(self, shapes: Sequence[Tuple[int, ...]],
                  slots_per_shape: int, dtype=np.uint8):
         if slots_per_shape < 1:
             raise ValueError("slots_per_shape must be >= 1, got %r"
                              % (slots_per_shape,))
         self.dtype = np.dtype(dtype)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("StagingPool._lock")
         self._available = threading.Condition(self._lock)
         self._slots: Dict[Tuple[int, ...], List[StagingSlot]] = {}
         for shape in shapes:
@@ -142,14 +156,27 @@ class StagingPool:
 
     # -- lifecycle ----------------------------------------------------
 
-    def _confirm_locked(self, slot: StagingSlot) -> None:
-        """Retire a free slot's lazily-pending transfers: wait for the
-        device copies, probe for host-buffer aliasing, and swap in a
-        fresh buffer when the device array took ownership of this one.
-        Called with the lock held, only on slots with no live refs."""
-        if slot.pending_confirm:
+    def _claim_pending_locked(self, slot: StagingSlot) -> List[Any]:
+        """Detach a just-claimed slot's lazily-pending transfers for
+        confirmation OUTSIDE the lock: the slot's state is already
+        DECODING, so no other acquirer can reach it, and the device
+        sync the confirmation blocks on must never run under the pool
+        lock (rnb-lint RNB-C005 — it would stall every producer and
+        worker behind one device round-trip)."""
+        lockwitness.require("StagingPool._lock")
+        pending, slot.pending_confirm = slot.pending_confirm, []
+        return pending
+
+    def _confirm_claimed(self, slot: StagingSlot,
+                         pending: List[Any]) -> None:
+        """Retire the detached pending transfers of a slot this caller
+        claimed: wait for the device copies, probe for host-buffer
+        aliasing, and swap in a fresh buffer when a device array took
+        ownership of this one. Runs WITHOUT the pool lock — the slot
+        is owner-private (state DECODING) until the caller hands it
+        on, so ``buf``/``tainted`` cannot race."""
+        if pending:
             jax, _ = _jax_numpy()
-            pending, slot.pending_confirm = slot.pending_confirm, []
             for arr in pending:
                 jax.block_until_ready(arr)
                 if _aliases(arr, slot.buf):
@@ -160,7 +187,8 @@ class StagingPool:
             # copy: still cheaper than the seed alloc+memcpy path.
             slot.buf = np.empty(slot.shape, dtype=slot.dtype)
             slot.tainted = False
-            self.num_reallocs += 1
+            with self._lock:
+                self.num_reallocs += 1
 
     def _acquirable_locked(self, shape) -> Optional[StagingSlot]:
         for slot in self._slots[shape]:
@@ -182,10 +210,11 @@ class StagingPool:
             slot = self._acquirable_locked(shape)
             if slot is None:
                 return None
-            self._confirm_locked(slot)
             slot.state = DECODING
             self.num_acquires += 1
-            return slot
+            pending = self._claim_pending_locked(slot)
+        self._confirm_claimed(slot, pending)
+        return slot
 
     def acquire(self, shape) -> StagingSlot:
         """Blocking acquire: counted backpressure on exhaustion."""
@@ -199,6 +228,7 @@ class StagingPool:
         with hostprof.section("staging.acquire_wait"), \
                 trace.span("staging.acquire_wait"):
             while True:
+                pending = None
                 with self._available:
                     self.raise_if_failed_locked()
                     slot = self._acquirable_locked(shape)
@@ -206,10 +236,12 @@ class StagingPool:
                         self._available.wait(timeout=0.05)
                         slot = self._acquirable_locked(shape)
                     if slot is not None:
-                        self._confirm_locked(slot)
                         slot.state = DECODING
                         self.num_acquires += 1
-                        return slot
+                        pending = self._claim_pending_locked(slot)
+                if slot is not None:
+                    self._confirm_claimed(slot, pending)
+                    return slot
 
     def add_ref(self, slot: StagingSlot) -> None:
         """One more planned decode targets rows of this slot."""
@@ -353,10 +385,17 @@ class TransferWorker:
     (wired through the stage's ``take_ready()``).
     """
 
+    GUARDED_BY = {
+        "_jobs": "_lock",
+        "_outstanding": "_lock",
+        "_error": "_lock",
+        "_closed": "_lock",
+    }
+
     def __init__(self, name: str = "rnb-transfer",
                  pool: Optional[StagingPool] = None):
         self._jobs: "deque[Optional[Callable[[], None]]]" = deque()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("TransferWorker._lock")
         self._wake = threading.Condition(self._lock)
         self._outstanding = 0
         self._error: Optional[BaseException] = None

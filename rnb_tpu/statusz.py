@@ -166,6 +166,20 @@ class OperatorServer:
     lock backs the ``Operator:`` line.
     """
 
+    GUARDED_BY = {
+        "scrapes": "_lock",
+        "actions": "_lock",
+        "denied": "_lock",
+        "errors": "_lock",
+    }
+
+    UNGUARDED_OK = {
+        "_httpd": "controller-thread lifecycle (start/stop)",
+        "_thread": "controller-thread lifecycle (start/stop)",
+        "port": "written once by start() before the serve thread "
+                "launches; later reads see an immutable publish",
+    }
+
     def __init__(self, settings: OperatorSettings,
                  job_dir: Optional[str] = None, job_id: str = "",
                  metrics_registry=None,

@@ -350,6 +350,21 @@ META_LINE_REGISTRY = (
               "per-class network fault counts off the PR 1 taxonomy "
               "(refused/reset/timeout/partial_frame/corrupt); "
               "--check re-sums the classes to total"),
+    StampSpec("Locks:", "rnb_tpu/benchmark.py",
+              "lock-order witness ledger (rnb_tpu.lockwitness, root "
+              "`lint.lock_witness` config key): witnessed locks, "
+              "total acquisitions, distinct acquisition-order edges, "
+              "discipline violations (order inversions + non-LIFO "
+              "releases + require() failures) — witness-enabled runs "
+              "only; --check holds violations to zero and the "
+              "Lock edges: detail to edges/violations counts"),
+    StampSpec("Lock edges:", "rnb_tpu/benchmark.py",
+              "JSON detail for the Locks: line: the observed "
+              "acquisition-order edges and any violation records; "
+              "--check holds every observed edge to the static "
+              "RNB-C lock-order graph (observed subset-of declared, "
+              "so a runtime order the analyzer never blessed fails "
+              "offline)"),
 )
 
 #: every ``# <kind> ...`` trailer a per-instance timing table may carry

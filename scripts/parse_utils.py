@@ -247,6 +247,23 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["net_" + key] = int(val)
+        elif line.startswith("Lock edges:"):
+            # JSON {"edges": [[a, b], ...], "violations": [...]} —
+            # the lock-order witness's observed acquisition-order
+            # graph (rnb_tpu.lockwitness), witness-armed runs only;
+            # --check holds every observed edge to the static RNB-C
+            # lock-order graph
+            import json
+            meta["lock_edge_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Locks:"):
+            # "Locks: tracked=L acquires=A edges=E violations=V" —
+            # the lock-order witness ledger (rnb_tpu.lockwitness),
+            # witness-armed runs only; --check holds violations to
+            # zero and the counts to the Lock edges: detail
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["locks_" + key] = int(val)
         elif line.startswith("Phases:"):
             # JSON {phase: {mean_ms, p99_ms, count}} — the per-request
             # latency attribution over steady-state completions,
@@ -1143,6 +1160,7 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # the dedup ledger (exactly-once), and a target-reached run may
     # strand nothing in the resend window
     problems.extend(_check_netedge(meta))
+    problems.extend(_check_locks(meta))
     return problems, parse_failed
 
 
@@ -1232,6 +1250,74 @@ def _check_health(meta: Dict[str, object],
                 "only %d of %d requests terminated (completed + "
                 "failed + shed) on a target-reached chaos run — the "
                 "rest are stranded" % (terminated, meta["videos"]))
+    return problems
+
+
+def _check_locks(meta: Dict[str, object]) -> List[str]:
+    """Lock-order witness invariants (rnb_tpu.lockwitness): the
+    'Locks:' counters must foot against the 'Lock edges:' detail,
+    the run must record ZERO discipline violations, and every
+    observed acquisition-order edge must appear in the static RNB-C
+    lock-order graph — a runtime order the analyzer never blessed is
+    an undeclared lock dependency, offline-checkable."""
+    problems: List[str] = []
+    if "locks_tracked" not in meta:
+        if "lock_edge_detail" in meta:
+            problems.append("log-meta carries a 'Lock edges:' line "
+                            "but no 'Locks:' totals line")
+        return problems
+    if "lock_edge_detail" not in meta:
+        problems.append("log-meta carries a 'Locks:' line but no "
+                        "'Lock edges:' detail line")
+        return problems
+    detail = meta["lock_edge_detail"]
+    edges = [tuple(e) for e in detail.get("edges", [])]
+    violations = detail.get("violations", [])
+    for key in ("locks_tracked", "locks_acquires", "locks_edges",
+                "locks_violations"):
+        if meta.get(key, 0) < 0:
+            problems.append("negative %s" % key)
+    if len(edges) != meta.get("locks_edges", 0):
+        problems.append(
+            "'Lock edges:' lists %d edge(s) but the Locks: line says "
+            "edges=%d" % (len(edges), meta.get("locks_edges", 0)))
+    if len(violations) != meta.get("locks_violations", 0):
+        problems.append(
+            "'Lock edges:' lists %d violation(s) but the Locks: line "
+            "says violations=%d"
+            % (len(violations), meta.get("locks_violations", 0)))
+    if violations:
+        problems.append(
+            "lock-order witness recorded %d discipline violation(s): "
+            "%s" % (len(violations), "; ".join(
+                str(v) for v in violations[:5])))
+    if meta.get("locks_edges", 0) > meta.get("locks_acquires", 0):
+        problems.append(
+            "locks_edges=%d exceeds locks_acquires=%d — an order "
+            "edge with no acquisition behind it"
+            % (meta.get("locks_edges", 0),
+               meta.get("locks_acquires", 0)))
+    named = {name for edge in edges for name in edge}
+    if len(named) > meta.get("locks_tracked", 0):
+        problems.append(
+            "%d distinct lock name(s) appear in edges but only "
+            "locks_tracked=%d were witnessed"
+            % (len(named), meta.get("locks_tracked", 0)))
+    if edges:
+        try:
+            from rnb_tpu.analysis.concurrency import \
+                static_lock_order_edges
+            declared = static_lock_order_edges()
+        except Exception as e:
+            problems.append("static lock-order graph unavailable "
+                            "(%s) — observed edges unverified" % e)
+        else:
+            for a, b in edges:
+                if (a, b) not in declared:
+                    problems.append(
+                        "observed lock-order edge %s -> %s is not in "
+                        "the static RNB-C lock-order graph — an "
+                        "undeclared runtime lock dependency" % (a, b))
     return problems
 
 

@@ -35,14 +35,14 @@ sys.path.insert(0, REPO)
 # see tests/conftest.py)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-FAMILIES = ("graph", "hotpath", "schema")
+FAMILIES = ("graph", "hotpath", "schema", "concurrency")
 
 #: rule-id prefix each family owns — single-family runs only consider
 #: the baseline entries of the families that actually ran, so a clean
 #: `--family graph` run is not failed by untested hotpath entries
 #: reading as stale
 FAMILY_RULE_PREFIX = {"graph": "RNB-G", "hotpath": "RNB-H",
-                      "schema": "RNB-T"}
+                      "schema": "RNB-T", "concurrency": "RNB-C"}
 
 
 def run(family_names, config_paths, baseline_path, verbose=False,
@@ -55,17 +55,23 @@ def run(family_names, config_paths, baseline_path, verbose=False,
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
-    from rnb_tpu.analysis import graph, hotpath, schema
     from rnb_tpu.analysis.findings import Baseline, apply_baseline
 
     findings = []
     if "graph" in family_names:
+        from rnb_tpu.analysis import graph
         findings.extend(graph.check_configs(config_paths, root=REPO))
     if "hotpath" in family_names:
+        from rnb_tpu.analysis import hotpath
         findings.extend(hotpath.check_package(
             os.path.join(REPO, "rnb_tpu"), root=REPO))
     if "schema" in family_names:
+        from rnb_tpu.analysis import schema
         findings.extend(schema.check_repo(REPO))
+    if "concurrency" in family_names:
+        from rnb_tpu.analysis import concurrency
+        findings.extend(concurrency.check_package(
+            os.path.join(REPO, "rnb_tpu"), root=REPO))
 
     baseline = Baseline.load(baseline_path)
     prefixes = tuple(FAMILY_RULE_PREFIX[f] for f in family_names)
@@ -105,7 +111,23 @@ def main(argv=None) -> int:
                         help="intentional-exception list")
     parser.add_argument("--verbose", action="store_true",
                         help="also print baseline-suppressed findings")
+    parser.add_argument("--stamps", action="store_true",
+                        help="print the declared concurrency-contract "
+                             "registry (GUARDED_BY / UNGUARDED_OK per "
+                             "class) and exit")
     args = parser.parse_args(argv)
+
+    if args.stamps:
+        from rnb_tpu.analysis import concurrency
+        for file, cls, guarded, unguarded in \
+                concurrency.contract_registry(
+                    os.path.join(REPO, "rnb_tpu")):
+            print("%s %s" % (file, cls))
+            for attr in sorted(guarded):
+                print("  %-24s guarded by %s" % (attr, guarded[attr]))
+            for attr in sorted(unguarded):
+                print("  %-24s unguarded: %s" % (attr, unguarded[attr]))
+        return 0
 
     families = tuple(args.family) if args.family else FAMILIES
     configs = (args.config if args.config

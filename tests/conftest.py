@@ -21,7 +21,24 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    """Run every test under the runtime lock-order witness: any pool,
+    cache, pager, health or netedge object a test constructs gets
+    witnessed locks, so lock-order inversions and ``*_locked``
+    convention breaches surface as recorded violations wherever a test
+    (or the races gate) chooses to assert on them. The fixture itself
+    never asserts — a test that wants the discipline checked reads
+    ``lockwitness.summary()`` explicitly."""
+    from rnb_tpu import lockwitness
+    lockwitness.enable()
+    lockwitness.reset()
+    yield
+    lockwitness.reset()

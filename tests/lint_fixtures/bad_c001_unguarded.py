@@ -1,0 +1,21 @@
+"""RNB-C001 bad fixture: a GUARDED_BY attribute read outside the
+declared lock (the writes are disciplined, so only C001 fires)."""
+
+import threading
+
+
+class Ledger:
+    GUARDED_BY = {"_entries": "_lock", "_total": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._total = 0
+
+    def add(self, key, n):
+        with self._lock:
+            self._entries[key] = n
+            self._total += n
+
+    def total(self):
+        return self._total

@@ -1,0 +1,22 @@
+"""RNB-C002 bad fixture: the thread entry point whose name declares
+the read-only ``rnb-poll`` role writes shared state (locked, so C001
+stays quiet; declared, so C003 stays quiet — only C002 fires)."""
+
+import threading
+
+
+class Poller:
+    GUARDED_BY = {"_seen": "_lock"}
+
+    READ_ONLY_ROLES = {"rnb-poll": "the poll thread observes, the "
+                                   "caller thread mutates"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        name="rnb-poll_1")
+
+    def _poll_loop(self):
+        with self._lock:
+            self._seen += 1

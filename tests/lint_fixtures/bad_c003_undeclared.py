@@ -1,0 +1,14 @@
+"""RNB-C003 bad fixture: a lock-owning class mutates an undeclared
+attribute after __init__ (no GUARDED_BY/UNGUARDED_OK entry)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1

@@ -1,0 +1,26 @@
+"""RNB-C004 bad fixture: Outer nests its lock around Inner's while
+Inner nests the other way — a two-lock order cycle."""
+
+import threading
+
+
+class Outer:
+    def __init__(self, inner):
+        self._a_lock = threading.Lock()
+        self.inner = inner
+
+    def one(self):
+        with self._a_lock:
+            with self.inner._b_lock:
+                pass
+
+
+class Inner:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+        self.outer = None
+
+    def two(self):
+        with self._b_lock:
+            with self.outer._a_lock:
+                pass

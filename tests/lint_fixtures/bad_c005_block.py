@@ -1,0 +1,19 @@
+"""RNB-C005 bad fixture: a blocking queue pop while holding the
+lock — every other thread touching the ledger stalls behind IO."""
+
+import threading
+
+
+class Worker:
+    GUARDED_BY = {"_jobs": "_lock"}
+
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._q = q
+        self._jobs = {}
+
+    def take(self, key):
+        with self._lock:
+            item = self._q.get()
+            self._jobs[key] = item
+            return item

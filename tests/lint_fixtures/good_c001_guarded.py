@@ -1,0 +1,31 @@
+"""RNB-C001 good fixture: every GUARDED_BY access holds the lock —
+via the with block, or via the *_locked callee convention."""
+
+import threading
+
+
+class Ledger:
+    GUARDED_BY = {"_entries": "_lock", "_total": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._total = 0
+
+    def add(self, key, n):
+        with self._lock:
+            self._entries[key] = n
+            self._total += n
+
+    def total(self):
+        with self._lock:
+            return self._total
+
+    def _drain_locked(self):
+        out, self._entries = self._entries, {}
+        self._total = 0
+        return out
+
+    def drain(self):
+        with self._lock:
+            return self._drain_locked()

@@ -1,0 +1,25 @@
+"""RNB-C002 good fixture: the declared read-only poll thread only
+reads under the lock; mutation lives on an un-roled method."""
+
+import threading
+
+
+class Poller:
+    GUARDED_BY = {"_seen": "_lock"}
+
+    READ_ONLY_ROLES = {"rnb-poll": "the poll thread observes, the "
+                                   "caller thread mutates"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        name="rnb-poll_1")
+
+    def _poll_loop(self):
+        with self._lock:
+            return self._seen
+
+    def bump(self):
+        with self._lock:
+            self._seen += 1

@@ -1,0 +1,16 @@
+"""RNB-C003 good fixture: the lock-owning class declares every
+attribute it mutates after __init__."""
+
+import threading
+
+
+class Counter:
+    GUARDED_BY = {"_n": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1

@@ -1,0 +1,27 @@
+"""RNB-C004 good fixture: both nesting sites acquire in the same
+global order (Outer._a_lock before Inner._b_lock) — an order graph
+with edges but no cycle."""
+
+import threading
+
+
+class Outer:
+    def __init__(self, inner):
+        self._a_lock = threading.Lock()
+        self.inner = inner
+
+    def one(self):
+        with self._a_lock:
+            with self.inner._b_lock:
+                pass
+
+
+class Inner:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+        self.outer = None
+
+    def two(self):
+        with self.outer._a_lock:
+            with self._b_lock:
+                pass

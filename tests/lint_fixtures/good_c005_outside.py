@@ -1,0 +1,22 @@
+"""RNB-C005 good fixture: the blocking queue pop happens before the
+lock; only the bounded ledger update runs under it. ``d.get(key)``
+(a dict probe with positional args) must also stay quiet."""
+
+import threading
+
+
+class Worker:
+    GUARDED_BY = {"_jobs": "_lock", "_last": "_lock"}
+
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._q = q
+        self._jobs = {}
+        self._last = None
+
+    def take(self, key):
+        item = self._q.get()
+        with self._lock:
+            self._jobs[key] = item
+            self._last = self._jobs.get(key)
+            return item

@@ -1,0 +1,239 @@
+"""The runtime lock-order witness (rnb_tpu.lockwitness).
+
+* disabled path: plain factory locks, None summary — byte-stable
+* enabled path: acquisition counting, order-edge recording, inversion
+  / release / require() violation detection, reentrancy, Condition
+  compatibility, cross-thread merging, the violation cap
+* integration: the staging pool's claim-then-confirm protocol keeps
+  the device sync outside the pool lock (the PR's headline RNB-C005
+  fix), and the cache->pager nesting lands exactly on the static
+  graph's declared edge
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from rnb_tpu import lockwitness
+
+
+@pytest.fixture
+def witness():
+    """Fresh enabled witness; restores the prior enabled state (the
+    suite-wide autouse fixture keeps it on between tests)."""
+    was_enabled = lockwitness.enabled()
+    lockwitness.enable()
+    lockwitness.reset()
+    yield lockwitness
+    lockwitness.reset()
+    if not was_enabled:
+        lockwitness.disable()
+
+
+# -- disabled path ----------------------------------------------------
+
+def test_disabled_returns_plain_factory_lock():
+    was_enabled = lockwitness.enabled()
+    lockwitness.disable()
+    try:
+        plain = lockwitness.lock("X._lock")
+        assert not isinstance(plain, lockwitness.WitnessLock)
+        assert type(plain) is type(threading.Lock())
+        rlock = lockwitness.lock("X.rlock", threading.RLock)
+        assert not isinstance(rlock, lockwitness.WitnessLock)
+        assert lockwitness.summary() is None
+        # require/holds are free no-ops off
+        lockwitness.require("X._lock")
+        assert not lockwitness.holds("X._lock")
+    finally:
+        if was_enabled:
+            lockwitness.enable()
+
+
+# -- edges + counters -------------------------------------------------
+
+def test_nested_acquisition_records_one_edge(witness):
+    a = witness.lock("A._lock")
+    b = witness.lock("B._lock")
+    with a:
+        assert witness.holds("A._lock")
+        with b:
+            pass
+    snap = witness.summary()
+    assert snap["locks"] == 2
+    assert snap["acquires"] == 2
+    assert snap["edges"] == [("A._lock", "B._lock")]
+    assert snap["violations"] == []
+
+
+def test_order_inversion_is_a_violation(witness):
+    a = witness.lock("A._lock")
+    b = witness.lock("B._lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    snap = witness.summary()
+    assert len(snap["violations"]) == 1
+    assert "order inversion" in snap["violations"][0]
+
+
+def test_release_without_hold_is_a_violation(witness):
+    a = witness.lock("A._lock")
+    a._inner.acquire()  # hold the inner lock so release() is legal
+    a.release()
+    snap = witness.summary()
+    assert any("does not hold" in v for v in snap["violations"])
+
+
+def test_require_flags_the_locked_convention(witness):
+    a = witness.lock("A._lock")
+    witness.require("A._lock")  # not held -> violation
+    with a:
+        witness.require("A._lock")  # held -> clean
+    snap = witness.summary()
+    assert len(snap["violations"]) == 1
+    assert "required but not held" in snap["violations"][0]
+
+
+def test_reentrant_rlock_records_no_self_edge(witness):
+    r = witness.lock("P.lock", threading.RLock)
+    with r:
+        with r:
+            pass
+    snap = witness.summary()
+    assert snap["edges"] == []
+    assert snap["violations"] == []
+    assert snap["acquires"] == 2
+
+
+def test_condition_on_witness_lock_waits_and_notifies(witness):
+    inner = witness.lock("S._lock")
+    cond = threading.Condition(inner)
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        ready.append(1)
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert witness.summary()["violations"] == []
+
+
+def test_cross_thread_edges_merge(witness):
+    a = witness.lock("A._lock")
+    b = witness.lock("B._lock")
+
+    def nest():
+        with a:
+            with b:
+                pass
+
+    threads = [threading.Thread(target=nest) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = witness.summary()
+    assert snap["edges"] == [("A._lock", "B._lock")]
+    assert snap["violations"] == []
+    assert snap["acquires"] == 4
+
+
+def test_violation_list_is_capped(witness):
+    for _ in range(lockwitness.MAX_VIOLATIONS + 20):
+        witness.require("never.held")
+    snap = witness.summary()
+    assert len(snap["violations"]) == lockwitness.MAX_VIOLATIONS
+
+
+def test_format_edges_is_sorted_json(witness):
+    a = witness.lock("A._lock")
+    b = witness.lock("B._lock")
+    with a:
+        with b:
+            pass
+    snap = witness.summary()
+    payload = json.loads(witness.format_edges(snap))
+    assert payload["edges"] == [["A._lock", "B._lock"]]
+    assert payload["violations"] == []
+
+
+# -- integration: the fixed subsystems under the witness --------------
+
+def test_staging_confirm_runs_outside_the_pool_lock(witness):
+    """Regression for the RNB-C005 true positive this PR fixed: the
+    lazy transfer confirmation used to block_until_ready under the
+    pool lock. The claim/confirm split must sync the device OUTSIDE
+    it — proven by probing the witness's held-stack from inside the
+    sync itself."""
+    from rnb_tpu.staging import StagingPool
+
+    held_during_sync = []
+
+    class Probe:
+        def block_until_ready(self):
+            held_during_sync.append(
+                lockwitness.holds("StagingPool._lock"))
+            return self
+
+        def unsafe_buffer_pointer(self):
+            return 0  # never aliases the slot buffer
+
+    pool = StagingPool([(2, 4)], 1)
+    slot = pool.try_acquire((2, 4))
+    assert slot is not None
+    pool.begin_transfer(slot)
+    pool.finish_transfer(slot, Probe())  # lazy confirm: parks the probe
+    slot2 = pool.try_acquire((2, 4))     # claim processes the probe
+    assert slot2 is slot
+    assert held_during_sync == [False], \
+        "device sync ran under the pool lock"
+    assert witness.summary()["violations"] == []
+
+
+def test_cache_pager_nesting_matches_the_static_graph(witness):
+    """The one real cross-class nesting: a paged cache hit pins pages
+    under ClipCache._lock -> Pager.lock. The witness must observe
+    exactly the edge the static analyzer declares — the subset
+    invariant parse_utils --check enforces on real runs."""
+    import jax.numpy as jnp
+    from rnb_tpu.analysis.concurrency import static_lock_order_edges
+    from rnb_tpu.cache import ClipCache
+    from rnb_tpu.ops.pages import _page_writer_jit
+    from rnb_tpu.pager import Pager, PagerSettings
+
+    pager = Pager(PagerSettings(page_rows=1))
+    arena = pager.create_arena("clips", (16,), np.float32,
+                               budget_bytes=128)
+    cache = ClipCache(1.0)
+    cache.attach_arena(arena)
+    pool = jnp.zeros((2, 16), jnp.float32)
+    try:
+        assert cache.insert_pages(("vid",), pool, 0, 2)
+        plan = cache.acquire(("vid",))
+        assert plan is not None
+        plan.release()
+    finally:
+        # this insert compiles the memoized page writer for a shape
+        # test_pager's single-signature pin never uses — hand that
+        # test a fresh writer
+        _page_writer_jit.cache_clear()
+
+    snap = witness.summary()
+    observed = {tuple(e) for e in snap["edges"]}
+    assert ("ClipCache._lock", "Pager.lock") in observed
+    assert snap["violations"] == []
+    declared = static_lock_order_edges()
+    assert observed <= declared, observed - declared

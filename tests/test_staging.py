@@ -307,7 +307,8 @@ def test_post_emit_slot_mutation_cannot_reach_device_batch(tmp_path):
     for slots in pool._slots.values():
         for slot in slots:
             with pool._lock:
-                pool._confirm_locked(slot)
+                pending = pool._claim_pending_locked(slot)
+            pool._confirm_claimed(slot, pending)
             slot.buf[:] = 255
     np.testing.assert_array_equal(snap, np.asarray(pb.data))
 
