@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: lint test native stamps trace ragged multichip chaos netchaos \
-	metrics dct devobs benchdiff explain operator pages races
+	metrics dct devobs benchdiff explain operator pages races shard
 
 # Static analysis: pipeline graph checker over every shipped config,
 # hot-path AST lint over rnb_tpu/, telemetry schema checker — no JAX
@@ -43,6 +43,16 @@ ragged:
 # planner's predicted-vs-traced occupancy comparison).
 multichip:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/multichip_demo.py
+
+# Intra-stage sharding A/B (README "Intra-stage sharding"): the
+# weight-gathered shard_map forward at degrees 2/4 asserted BITWISE
+# identical to the unsharded stage with one compiled signature per
+# arm, the degree-1 launch rejected under an HBM budget degree 2
+# satisfies, a same-seed d1-vs-d2 run_benchmark A/B with
+# parse_utils --check green on both arms, and the planner + whatif
+# degree counterfactual validated against the executed arms.
+shard:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/shard_demo.py
 
 # Replica-loss chaos gate (README "Self-healing & chaos"): seeded
 # mid-stream kill of 1 of 4 replica lanes on the shipped chaos arm,

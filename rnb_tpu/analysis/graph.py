@@ -49,6 +49,15 @@ Rules
   different capacity would silently change every declared wire shape
   and warmup signature (the stage constructor rejects it at launch;
   this rule rejects it statically).
+* ``RNB-G010`` shard-spec: a step's ``shard`` key is unusable as
+  declared — the model class declares no partition spec
+  (``SUPPORTS_SHARD``), the degree does not divide every declared
+  output-channel width of the stage's layer range
+  (rnb_tpu.parallel.shardplan.validate_degree — a non-dividing degree
+  cannot slice the weights), or the expanded shard rings oversubscribe
+  the step's mesh (a device appearing twice in one ring, or shared
+  between two replica lanes' rings). The stage constructor rejects the
+  first two at launch; this rule rejects all three statically.
 
 Ragged interplay: with the root ``ragged`` key enabled, participating
 stages ship exactly one shape (the pool) with a traced ``rows_valid``
@@ -200,10 +209,15 @@ def check_config(path: str, root: str = ".") -> List[Finding]:
                         "(0 disables caching), got %r" % (cache_mb,)))
 
             if cls is not None:
+                # shard_* keys are parse-time wiring from the step's
+                # 'shard' object, not user config — a class that can't
+                # consume them is RNB-G010's finding, not a typo
                 unknown = sorted(
                     k for k in kwargs
                     if k not in consumed_config_keys(cls)
-                    and not k.startswith("_"))
+                    and not k.startswith("_")
+                    and k not in ("shard_devices", "shard_degree",
+                                  "shard_axis", "shard_hbm_budget_mb"))
                 for key in unknown:
                     findings.append(Finding(
                         "RNB-G005", rel, 0, "%s.%s" % (anchor, key),
@@ -246,6 +260,71 @@ def check_config(path: str, root: str = ".") -> List[Finding]:
                         "stage's one compiled shape, so its capacity "
                         "must equal the declared max"
                         % (pool_rows, cls.__name__, declared_max)))
+
+    # intra-stage sharding (step 'shard' key,
+    # rnb_tpu.parallel.shardplan): the declared degree must have a
+    # partition spec to act on (SUPPORTS_SHARD), must divide every
+    # declared output-channel width of the stage's layer range, and
+    # the expanded rings must not oversubscribe the step's mesh —
+    # the constructor-time gates, checked statically
+    for step_idx, (step, cls) in enumerate(zip(config.steps, classes)):
+        seen_ring_devices: set = set()
+        for group_idx, group in enumerate(step.groups):
+            kwargs = step.kwargs_for_group(group_idx)
+            degree = kwargs.get("shard_degree")
+            if degree is None:
+                continue
+            anchor = "step%d.group%d.shard" % (step_idx, group_idx)
+            if cls is not None and not getattr(cls, "SUPPORTS_SHARD",
+                                               False):
+                findings.append(Finding(
+                    "RNB-G010", rel, 0, anchor,
+                    "'shard' on a %s step, but the class declares no "
+                    "partition spec (SUPPORTS_SHARD) — no parameter "
+                    "axis is declared shardable, so the degree has "
+                    "nothing to slice" % cls.__name__))
+                continue
+            if cls is not None:
+                from rnb_tpu.parallel.shardplan import validate_degree
+                try:
+                    sig = inspect.signature(cls.__init__)
+                except (TypeError, ValueError):
+                    sig = None
+
+                def _resolved_kwarg(name, fallback):
+                    if name in kwargs:
+                        return kwargs[name]
+                    if sig is not None:
+                        param = sig.parameters.get(name)
+                        if param is not None and param.default \
+                                is not inspect.Parameter.empty:
+                            return param.default
+                    return fallback
+                try:
+                    validate_degree(
+                        int(degree),
+                        int(_resolved_kwarg("start_index", 1)),
+                        int(_resolved_kwarg("end_index", 5)),
+                        int(_resolved_kwarg("num_classes", 400)))
+                except ValueError as e:
+                    findings.append(Finding(
+                        "RNB-G010", rel, 0, anchor, str(e)))
+            ring = list(kwargs.get("shard_devices") or [])
+            if len(set(ring)) != len(ring):
+                findings.append(Finding(
+                    "RNB-G010", rel, 0, anchor,
+                    "shard ring %s lists a device more than once — a "
+                    "degree-%s ring needs that many DISTINCT devices"
+                    % (ring, degree)))
+            overlap = sorted(set(ring) & seen_ring_devices)
+            if overlap:
+                findings.append(Finding(
+                    "RNB-G010", rel, 0, anchor,
+                    "shard ring %s shares device(s) %s with another "
+                    "replica lane of the same step — lanes' rings "
+                    "oversubscribe the step's mesh"
+                    % (ring, overlap)))
+            seen_ring_devices.update(ring)
 
     # load-adaptive batching (root 'autotune' key, rnb_tpu.autotune):
     # an autotune.buckets restriction must stay inside each

@@ -253,6 +253,7 @@ COUNTER_LINE_PREFIXES = {"Faults:": "", "Cache:": "cache_",
                          "Autotune:": "autotune_",
                          "Trace:": "trace_",
                          "Ragged:": "ragged_",
+                         "Shard:": "shard_",
                          "Handoff:": "handoff_",
                          "Padding:": "",
                          "Health:": "health_",
@@ -541,6 +542,7 @@ def check_benchmark_result(benchmark_path: str, root: str = "."
                 or field.startswith("autotune_") \
                 or field.startswith("trace_") \
                 or field.startswith("ragged_") \
+                or field.startswith("shard_") \
                 or field.startswith("handoff_") \
                 or field.startswith("health_") \
                 or field.startswith("deadline_") \

@@ -166,6 +166,23 @@ class BenchmarkResult:
     ragged_rows: int = 0
     ragged_pad_rows_eliminated: int = 0
     ragged_cache_hit_rows: int = 0
+    #: intra-stage shard accounting (rnb_tpu.parallel.shardplan, step
+    #: `shard` config key), summed over every declared-degree stage
+    #: instance; all zero without the key. Degree buys per-device HBM
+    #: feasibility, never speed: gathers counts logits-path merge
+    #: collectives, collective_us their summed host-timed wall
+    #: (nested inside the model_call span, so it never adds to
+    #: inference time), rows the valid rows that crossed a sharded
+    #: stage.
+    shard_steps: int = 0
+    shard_max_degree: int = 0
+    shard_gathers: int = 0
+    shard_collective_us: int = 0
+    shard_rows: int = 0
+    #: per-step shard detail (the `Shard steps:` JSON meta line):
+    #: degree/axis, merge-gather counters, projected vs budget MiB,
+    #: and the memledger-projected min feasible degree
+    shard_step_detail: Dict[str, Any] = field(default_factory=dict)
     #: paged device-memory accounting (rnb_tpu.pager, root `pager`
     #: config key) — the `Pages:` meta line verbatim: page
     #: alloc/free/live occupancy, gather dispatches split by plane
@@ -455,6 +472,7 @@ def run_benchmark(config_path: str,
     compile_sink: list = []
     pad_sink: list = []
     ragged_sink: list = []
+    shard_sink: list = []
     fault_stats = FaultStats()
     # load-adaptive batching (rnb_tpu.autotune): one validated settings
     # object shared by every participating stage; per-step opt-out via
@@ -940,6 +958,7 @@ def run_benchmark(config_path: str,
                     compile_sink=compile_sink,
                     pad_sink=pad_sink,
                     ragged_sink=ragged_sink,
+                    shard_sink=shard_sink,
                     tracer=tracer,
                     handoff_settings=handoff_settings,
                     handoff_edge=("step%d->step%d"
@@ -1251,6 +1270,39 @@ def run_benchmark(config_path: str,
                         "cache_hit_rows"):
                 ragged_stats[key] += int(snap.get(key, 0))
 
+    # intra-stage shard accounting (rnb_tpu.parallel.shardplan):
+    # declared-degree stages snapshot their merge-collective counters
+    # at teardown; replica lanes of the same step sum, the static
+    # facts (degree/axis/budgets) are per-step constants
+    shard_stats = None
+    if shard_sink:
+        per_step: Dict[int, Dict[str, Any]] = {}
+        for shard_step_idx, snap in shard_sink:
+            row = per_step.setdefault(shard_step_idx, {
+                "degree": int(snap.get("degree", 1)),
+                "axis": str(snap.get("axis", "")),
+                "gathers": 0, "collective_us": 0, "rows": 0,
+                "budget_mb": round(float(snap.get("budget_mb") or 0.0),
+                                   3),
+                "projected_mb": round(
+                    float(snap.get("projected_mb", 0.0)), 3),
+                "min_degree": int(snap.get("min_degree", 0)),
+            })
+            row["gathers"] += int(snap.get("gathers", 0))
+            row["collective_us"] += int(round(
+                float(snap.get("collective_ms", 0.0)) * 1e3))
+            row["rows"] += int(snap.get("rows", 0))
+        shard_stats = {
+            "steps": len(per_step),
+            "max_degree": max(r["degree"] for r in per_step.values()),
+            "gathers": sum(r["gathers"] for r in per_step.values()),
+            "collective_us": sum(r["collective_us"]
+                                 for r in per_step.values()),
+            "rows": sum(r["rows"] for r in per_step.values()),
+            "step_detail": {str(k): per_step[k]
+                            for k in sorted(per_step)},
+        }
+
     handoff_stats = None
     if handoff_sink:
         from rnb_tpu.handoff import aggregate_snapshots as \
@@ -1469,6 +1521,22 @@ def run_benchmark(config_path: str,
                        ragged_stats["emissions"], ragged_stats["rows"],
                        ragged_stats["pad_rows_eliminated"],
                        ragged_stats["cache_hit_rows"]))
+        if shard_stats is not None:
+            # only declared-shard runs carry the lines, keeping
+            # unsharded logs byte-stable with the earlier schema;
+            # --check holds degree x replicas <= the device budget,
+            # collective_us <= the inference span sum (the merge is
+            # nested inside model_call), and per-step rows footing
+            f.write("Shard: steps=%d max_degree=%d gathers=%d "
+                    "collective_us=%d rows=%d\n"
+                    % (shard_stats["steps"],
+                       shard_stats["max_degree"],
+                       shard_stats["gathers"],
+                       shard_stats["collective_us"],
+                       shard_stats["rows"]))
+            f.write("Shard steps: %s\n"
+                    % json.dumps(shard_stats["step_detail"],
+                                 sort_keys=True))
         if handoff_stats is not None:
             # only handoff-enabled runs carry the lines, keeping
             # pre-handoff logs byte-stable with the earlier schema;
@@ -1978,6 +2046,15 @@ def run_benchmark(config_path: str,
             ragged_stats["pad_rows_eliminated"] if ragged_stats else 0),
         ragged_cache_hit_rows=(ragged_stats["cache_hit_rows"]
                                if ragged_stats else 0),
+        shard_steps=shard_stats["steps"] if shard_stats else 0,
+        shard_max_degree=(shard_stats["max_degree"]
+                          if shard_stats else 0),
+        shard_gathers=shard_stats["gathers"] if shard_stats else 0,
+        shard_collective_us=(shard_stats["collective_us"]
+                             if shard_stats else 0),
+        shard_rows=shard_stats["rows"] if shard_stats else 0,
+        shard_step_detail=(dict(shard_stats["step_detail"])
+                           if shard_stats else {}),
         pages=dict(pages_summary) if pages_summary else {},
         compile_signatures=compile_stats,
         warmup_s=warmup_stats,

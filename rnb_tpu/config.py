@@ -29,8 +29,12 @@ RESERVED_KEYWORDS = [
     "model", "queue_groups", "num_shared_tensors", "num_segments",
     "in_queue", "out_queues", "devices", "gpus", "queue_selector",
     "async_dispatch", "max_retries", "retry_backoff_ms", "autotune",
-    "replicas", "hedge_ms",
+    "replicas", "hedge_ms", "shard",
 ]
+
+#: keys a step-level 'shard' object may carry
+#: (rnb_tpu.parallel.shardplan)
+SHARD_KEYWORDS = ["degree", "axis", "hbm_budget_mb"]
 
 #: root-level keys with meaning to the runtime (everything else at the
 #: root is rejected to catch typos like "overload_polcy")
@@ -490,6 +494,92 @@ def _expand_replicas(pipeline: list, placement: Optional[Dict[str, Any]]
                 "the replica lanes cannot be wired" % (where, orig_in))
         replica_queues[step_idx] = tuple(lanes)
     return pipeline, replica_queues
+
+
+def _expand_shard(pipeline: list) -> list:
+    """Intra-stage tensor parallelism (rnb_tpu.parallel.shardplan):
+    translate every step's ``shard: {degree, axis, hbm_budget_mb}``
+    key into per-group constructor kwargs. Runs AFTER replica
+    expansion, so the two compose replica-major: ``replicas: N``
+    first carves the step's device list into N equal lane sub-meshes,
+    then each lane's sub-mesh must be exactly ``degree`` devices —
+    its shard ring. The group keeps ONE primary device (the executor
+    spawns one instance per listed device; a shard ring is one
+    executable over k devices, not k executors) and the full ring
+    travels to the stage as ``shard_devices``.
+
+    Returns the (possibly copied) pipeline; the input list is never
+    mutated when a shard key is present (``config.raw`` keeps the
+    as-written form).
+    """
+    import copy
+
+    if not any(isinstance(step, dict) and step.get("shard") is not None
+               for step in pipeline):
+        return pipeline
+    pipeline = copy.deepcopy(pipeline)
+    for step_idx, step in enumerate(pipeline):
+        if not isinstance(step, dict):
+            continue
+        shard = step.get("shard")
+        if shard is None:
+            continue
+        where = "pipeline step %d" % step_idx
+        _expect(isinstance(shard, dict),
+                "%s: 'shard' must be an object" % where)
+        unknown = sorted(set(shard) - set(SHARD_KEYWORDS))
+        _expect(not unknown,
+                "%s: 'shard' has unknown key(s) %s — keys are %s"
+                % (where, unknown, SHARD_KEYWORDS))
+        degree = shard.get("degree")
+        _expect(isinstance(degree, int) and not isinstance(degree, bool)
+                and degree >= 1,
+                "%s: 'shard.degree' must be a positive integer, got %r"
+                % (where, degree))
+        axis = shard.get("axis", "tp")
+        _expect(isinstance(axis, str) and axis,
+                "%s: 'shard.axis' must be a non-empty string, got %r"
+                % (where, axis))
+        budget = shard.get("hbm_budget_mb")
+        _expect(budget is None
+                or (isinstance(budget, (int, float))
+                    and not isinstance(budget, bool) and budget > 0),
+                "%s: 'shard.hbm_budget_mb' must be a positive number, "
+                "got %r" % (where, budget))
+        _expect(step.get("num_segments", 1) == 1,
+                "%s: 'shard' cannot be combined with 'num_segments' "
+                "> 1 (segment siblings would each need their own "
+                "ring)" % where)
+        for group_idx, group in enumerate(step.get("queue_groups")
+                                          or []):
+            gwhere = "%s, queue group %d" % (where, group_idx)
+            _expect(isinstance(group, dict),
+                    "%s must be an object" % gwhere)
+            dev_key = ("devices" if "devices" in group
+                       else "gpus" if "gpus" in group else None)
+            _expect(dev_key is not None,
+                    "%s needs a 'devices' list" % gwhere)
+            devices = group[dev_key]
+            _expect(isinstance(devices, list)
+                    and len(devices) == degree,
+                    "%s: 'shard.degree'=%d needs exactly that many "
+                    "devices per lane (got %d) — with 'replicas' the "
+                    "step's device list must total replicas x degree"
+                    % (gwhere, degree,
+                       len(devices) if isinstance(devices, list)
+                       else 0))
+            _expect(all(d != -1 for d in devices),
+                    "%s: 'shard' rings cannot include the host "
+                    "(-1)" % gwhere)
+            # one primary device -> one executor instance; the ring
+            # rides the open kwargs passthrough to the stage
+            group[dev_key] = devices[:1]
+            group["shard_devices"] = list(devices)
+            group["shard_degree"] = degree
+            group["shard_axis"] = axis
+            if budget is not None:
+                group["shard_hbm_budget_mb"] = budget
+    return pipeline
 
 
 def load_config(path: str) -> PipelineConfig:
@@ -968,6 +1058,9 @@ def parse_config(raw: Dict[str, Any]) -> PipelineConfig:
     # any wiring validation, so the expanded form is the one canonical
     # topology everything checks and builds
     pipeline, replica_queues = _expand_replicas(pipeline, placement)
+    # intra-stage sharding composes replica-major: each replica lane's
+    # equal device slice becomes that lane's shard ring
+    pipeline = _expand_shard(pipeline)
 
     steps: List[StepConfig] = []
     prev_out_queues: Optional[set] = None

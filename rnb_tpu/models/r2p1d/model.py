@@ -33,6 +33,7 @@ from rnb_tpu.autotune import BatchController
 from rnb_tpu.cache import content_key
 from rnb_tpu.compilestats import SignatureTracker
 from rnb_tpu.decode import get_decoder
+from rnb_tpu.devices import DeviceSpec
 from rnb_tpu.decode.native import (DecodePool, NativeY4MDecoder, PIX_DCT,
                                    PIX_RGB, PIX_YUV420,
                                    default_decode_threads,
@@ -2384,6 +2385,12 @@ class R2P1DRunner(StageModel):
     #: (rnb_tpu.pager; enable_pager below)
     SUPPORTS_PAGER = True
 
+    #: this stage declares a partition spec for the step-level `shard`
+    #: key (rnb_tpu.parallel.shardplan): temporal conv kernels and the
+    #: head shard their output-channel axis. rnb-lint RNB-G010 rejects
+    #: `shard` on steps whose model class does not declare this.
+    SUPPORTS_SHARD = True
+
     def __init__(self, device, start_index: int = 1,
                  end_index: int = NUM_LAYERS,
                  num_classes: int = KINETICS_CLASSES,
@@ -2396,6 +2403,9 @@ class R2P1DRunner(StageModel):
                  pixel_path: str = "rgb",
                  ragged: bool = False, ragged_pool_rows=None,
                  ragged_chunk_rows=None, dct_coeffs_per_frame=None,
+                 shard_devices=None, shard_degree=None,
+                 shard_axis: str = "tp",
+                 shard_hbm_budget_mb=None,
                  **kwargs):
         super().__init__(device)
         import jax
@@ -2444,6 +2454,36 @@ class R2P1DRunner(StageModel):
                         "ragged_chunk_rows=%r must be 0 (whole-pool "
                         "apply) or a positive divisor of pool_rows=%d"
                         % (ragged_chunk_rows, self.pool_rows))
+        # Intra-stage tensor parallelism (rnb_tpu.parallel.shardplan):
+        # shard_degree=None means the step declared no `shard` key at
+        # all — a declared degree (1 included) arms the feasibility
+        # gate and the Shard: accounting, so an operator iterating
+        # degrees sees the same telemetry shape at every point
+        self.shard_declared = shard_degree is not None
+        self.shard_degree = int(shard_degree) if self.shard_declared \
+            else 1
+        self.shard_axis = str(shard_axis)
+        self.shard_hbm_budget_mb = (
+            float(shard_hbm_budget_mb)
+            if shard_hbm_budget_mb is not None else None)
+        if self.shard_degree < 1:
+            raise ValueError("shard_degree must be >= 1, got %r"
+                             % (shard_degree,))
+        if self.shard_degree > 1:
+            from rnb_tpu.parallel.shardplan import validate_degree
+            validate_degree(self.shard_degree, start_index, end_index,
+                            num_classes)
+            if self.ragged and self.ragged_chunk_rows:
+                if ragged_chunk_rows is not None:
+                    raise ValueError(
+                        "ragged_chunk_rows=%r cannot be combined with "
+                        "shard_degree=%d: the sharded applier is ONE "
+                        "whole-pool program (chunking would change the "
+                        "op graph and break bit parity with the "
+                        "unsharded forward)"
+                        % (ragged_chunk_rows, self.shard_degree))
+                # the auto-chunk default collapses to whole-pool apply
+                self.ragged_chunk_rows = 0
         layer_sizes = tuple(layer_sizes)
         self._jax_device = _resolve(device)
         #: the exact network-shape arguments the analytic FLOP walk
@@ -2457,16 +2497,54 @@ class R2P1DRunner(StageModel):
             factored_shortcut=bool(factored_shortcut))
         # factored_shortcut matches converted reference checkpoints
         # (models/r2p1d/convert.py); default is the plain projection
-        self._apply = _shared_apply(self.start_index, self.end_index,
-                                    num_classes, layer_sizes,
-                                    bool(factored_shortcut),
-                                    pixel_path=pixel_path,
-                                    ragged=self.ragged,
-                                    ragged_chunk=self.ragged_chunk_rows)
-        self._variables = _shared_params(self.start_index, self.end_index,
-                                         num_classes, layer_sizes,
-                                         ckpt_path, self._jax_device,
-                                         bool(factored_shortcut))
+        self._merge = None
+        self._input_sharding = None
+        self._shard_mesh = None
+        if self.shard_degree > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from rnb_tpu.parallel.shardplan import (
+                build_shard_mesh, make_sharded_apply, make_merge,
+                shard_variables)
+            if shard_devices is not None:
+                ring = [_resolve(DeviceSpec(d)) for d in shard_devices]
+            else:
+                ring = list(jax.devices()[:self.shard_degree])
+            if len(ring) != self.shard_degree:
+                raise ValueError(
+                    "shard_degree=%d needs exactly that many devices, "
+                    "got %d" % (self.shard_degree, len(ring)))
+            self._shard_mesh = build_shard_mesh(ring, self.shard_degree,
+                                                self.shard_axis)
+            host_vars = _shared_params(self.start_index, self.end_index,
+                                       num_classes, layer_sizes,
+                                       ckpt_path, self._jax_device,
+                                       bool(factored_shortcut))
+            self._variables = shard_variables(host_vars,
+                                              self._shard_mesh,
+                                              self.shard_axis)
+            self._apply = make_sharded_apply(
+                self.start_index, self.end_index, num_classes,
+                layer_sizes, self._shard_mesh,
+                factored_shortcut=bool(factored_shortcut),
+                pixel_path=pixel_path, ragged=self.ragged,
+                axis_name=self.shard_axis)(self._variables)
+            if self.end_index == NUM_LAYERS:
+                self._merge = make_merge(self._shard_mesh,
+                                         self.shard_axis)
+            self._input_sharding = NamedSharding(self._shard_mesh,
+                                                 PartitionSpec())
+        else:
+            self._apply = _shared_apply(self.start_index, self.end_index,
+                                        num_classes, layer_sizes,
+                                        bool(factored_shortcut),
+                                        pixel_path=pixel_path,
+                                        ragged=self.ragged,
+                                        ragged_chunk=self.ragged_chunk_rows)
+            self._variables = _shared_params(self.start_index,
+                                             self.end_index,
+                                             num_classes, layer_sizes,
+                                             ckpt_path, self._jax_device,
+                                             bool(factored_shortcut))
         # warm-up on the exact steady-state shape and dtype — both come
         # from the same static declarations (input_shape_for /
         # input_dtype_for) the pipeline checker matches against the
@@ -2499,6 +2577,70 @@ class R2P1DRunner(StageModel):
         self.pager = None
         self._feature_arena = None
         self._logit_pool = None
+        # Shard feasibility gate + accounting: a declared `shard` key
+        # (any degree, 1 included) projects the per-device HBM
+        # footprint with the ONE formula the planner also uses
+        # (shardplan.projected_device_mb) and — when hbm_budget_mb is
+        # armed — REJECTS the launch when the projection does not fit.
+        # This is the honest "this stage does not fit at this degree"
+        # failure the headline shard config demonstrates at degree 1;
+        # memledger owns the live accounting once a feasible launch
+        # runs.
+        self.shard_stats = None
+        if self.shard_declared:
+            from rnb_tpu.parallel.shardplan import (
+                min_feasible_degree, projected_device_mb,
+                split_param_bytes)
+            rep_bytes, sh_bytes = split_param_bytes(self._variables)
+            pool_bytes = 0
+            if self.ragged:
+                per_row = int(np.dtype(warm_dtype).itemsize)
+                for extent in self._steady_shape[1:]:
+                    per_row *= int(extent)
+                pool_bytes = int(self.pool_rows) * per_row
+            projected = projected_device_mb(rep_bytes, sh_bytes,
+                                            pool_bytes,
+                                            self.shard_degree)
+            floor = 1
+            if self.shard_hbm_budget_mb is not None:
+                floor = min_feasible_degree(
+                    rep_bytes, sh_bytes, pool_bytes,
+                    self.shard_hbm_budget_mb)
+            self.shard_stats = {
+                "degree": self.shard_degree,
+                "axis": self.shard_axis,
+                "gathers": 0,
+                "collective_ms": 0.0,
+                "rows": 0,
+                "budget_mb": self.shard_hbm_budget_mb,
+                "projected_mb": projected,
+                "replicated_bytes": int(rep_bytes),
+                "sharded_bytes": int(sh_bytes),
+                "pool_bytes": int(pool_bytes),
+                "min_degree": floor if floor is not None else 0,
+            }
+            if self.shard_hbm_budget_mb is not None \
+                    and projected > self.shard_hbm_budget_mb:
+                feasible = min_feasible_degree(
+                    rep_bytes, sh_bytes, pool_bytes,
+                    self.shard_hbm_budget_mb)
+                raise ValueError(
+                    "shard launch rejected: projected per-device HBM "
+                    "%.1f MiB at shard degree %d exceeds "
+                    "hbm_budget_mb=%.1f for layers [%d..%d] "
+                    "(replicated %.1f MiB + sharded %.1f MiB / degree "
+                    "+ pool %.1f MiB); smallest feasible degree of "
+                    "(1, 2, 4, 8): %s"
+                    % (projected, self.shard_degree,
+                       self.shard_hbm_budget_mb, self.start_index,
+                       self.end_index, rep_bytes / 2**20,
+                       sh_bytes / 2**20, pool_bytes / 2**20,
+                       feasible if feasible is not None else "none"))
+        #: set by the executor's bind_shard_step() so the merge
+        #: collective's hostprof section / trace span carry the step
+        #: index even on trace-disabled runs
+        self._sec_collective = None
+        self._tr_collective = None
         #: jit-entry signature accounting (rnb_tpu.compilestats):
         #: distinct applier input signatures == executables this stage
         #: requires; frozen by the executor at measured-window start
@@ -2513,17 +2655,37 @@ class R2P1DRunner(StageModel):
             # compile of an unwarmed run
             self.compiles.observe(host)
             if num_warmups > 0:
-                dummy = jax.device_put(host, self._jax_device)
+                if self._input_sharding is not None:
+                    dummy = jax.device_put(host, self._input_sharding)
+                else:
+                    dummy = jax.device_put(host, self._jax_device)
                 for _ in range(num_warmups):
                     if self.ragged:
-                        jax.block_until_ready(self._apply(
-                            self._variables, dummy, np.int32(rows)))
+                        out = self._apply(self._variables, dummy,
+                                          np.int32(rows))
                     else:
-                        jax.block_until_ready(
-                            self._apply(self._variables, dummy))
+                        out = self._apply(self._variables, dummy)
+                    jax.block_until_ready(out)
+                    if self._merge is not None:
+                        # warm the merge collective too: its compile
+                        # must not land inside the measured window
+                        jax.block_until_ready(self._merge(out))
 
     def input_shape(self):
         return (self._steady_shape,)
+
+    def bind_shard_step(self, step_idx: int) -> None:
+        """Executor protocol (rnb_tpu.runner): hand the stage its step
+        index so the merge collective can be host-timed under the
+        ``exec{i}.collective`` hostprof section / trace span. Called
+        unconditionally (unlike enable_trace) because the collective
+        tax must reach hostprof and the Shard: accounting even on
+        trace-disabled runs; a no-op for unsharded stages."""
+        if self._merge is None:
+            return
+        self._sec_collective = "exec%d.collective" % int(step_idx)
+        self._tr_collective = trace.name("exec%d.collective",
+                                         int(step_idx))
 
     def enable_pager(self, pager) -> None:
         """Executor protocol (rnb_tpu.runner): attach this stage as
@@ -2538,6 +2700,13 @@ class R2P1DRunner(StageModel):
         self.pager = pager
         if pager.feature is None:
             return
+        if self.shard_degree > 1:
+            raise ValueError(
+                "pager.feature_cache cannot attach to a shard-sharded "
+                "stage (shard_degree=%d): the feature arena is a "
+                "single-device gather pool, while sharded logits live "
+                "on a %d-device mesh" % (self.shard_degree,
+                                         self.shard_degree))
         if not self.ragged:
             raise ValueError(
                 "pager.feature_cache requires ragged dispatch on the "
@@ -2749,12 +2918,37 @@ class R2P1DRunner(StageModel):
                               (0, int(pb.valid)))
             return (RaggedBatch(out, pb.valid, offsets),), \
                 non_tensors, time_card
-        x = jax.device_put(pb.data, self._jax_device)
+        if self._input_sharding is not None:
+            x = jax.device_put(pb.data, self._input_sharding)
+        else:
+            x = jax.device_put(pb.data, self._jax_device)
         self.compiles.observe(x)
         if self.ragged:
             out = self._apply(self._variables, x, np.int32(pb.valid))
         else:
             out = self._apply(self._variables, x)
+        if self._merge is not None:
+            # the forward leaves logits channel-sharded; the merge
+            # gather is the stage-level collective, host-timed as its
+            # own span so the collective tax is a measured number —
+            # block on the forward first so the timing brackets ONLY
+            # the collective
+            jax.block_until_ready(out)
+            rid = getattr(time_card, "id", None)
+            t0 = time.perf_counter()
+            if self._sec_collective is not None:
+                with hostprof.section(self._sec_collective), \
+                        trace.span(self._tr_collective, rid):
+                    out = self._merge(out)
+                    jax.block_until_ready(out)
+            else:
+                out = self._merge(out)
+                jax.block_until_ready(out)
+            stats = self.shard_stats
+            stats["gathers"] += 1
+            stats["collective_ms"] += (time.perf_counter() - t0) * 1e3
+        if self.shard_stats is not None:
+            self.shard_stats["rows"] += int(pb.valid)
         self._insert_features(out, time_card)
         if self.ragged:
             # the pool shape rides through: downstream consumers (and

@@ -31,7 +31,25 @@ from __future__ import annotations
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
+
+from rnb_tpu.ops.handoff_dma import ring_all_gather_body
+
+
+def _gather_shard_params(axis_name: str, shards: int):
+    """``nn.map_variables`` trans_in_fn: reassemble a weight-sharded
+    module's full-width params from the local shard via the handoff
+    ring all-gather (pure data movement, so the gathered kernel is
+    bitwise the unsharded one). Only meaningful inside a ``shard_map``
+    over ``axis_name``."""
+    gather = ring_all_gather_body(axis_name, shards, axis=-1)
+
+    def trans_in(tree):
+        return jax.tree_util.tree_map(gather, tree)
+
+    return trans_in
 
 NUM_LAYERS = 5
 KINETICS_CLASSES = 400
@@ -106,12 +124,35 @@ def factored_channels(in_features: int, out_features: int,
 
 class SpatioTemporalConv(nn.Module):
     """(2+1)D factored convolution: spatial 2-D conv, BN, ReLU, then
-    temporal 1-D conv. Unbiased convs; BN carries the affine terms."""
+    temporal 1-D conv. Unbiased convs; BN carries the affine terms.
+
+    ``shards > 1`` is the intra-stage tensor-parallel form (used only
+    inside a ``shard_map`` over a ``shard_axis``-named mesh axis,
+    rnb_tpu.parallel.shardplan): the *temporal* conv kernel lives
+    SHARDED on its output-channel axis — each mesh member holds
+    ``1/shards`` of its bytes at rest, which is where degree k buys
+    its per-device HBM headroom — and is reassembled to full width by
+    the handoff ring all-gather right before the conv
+    (``nn.map_variables`` swaps the gathered kernel in). The conv
+    itself then runs at the FULL declared width, so the activation
+    math is op-for-op the unsharded program and the outputs are
+    bitwise identical — a gather is pure data movement, and keeping
+    the compute graph structurally identical is the only thing that
+    survives XLA's bf16 excess-precision fusion (output-channel
+    *compute* slicing is 1-ulp nondeterministic across program
+    shapes; see shardplan's module docstring). The spatial conv, BN
+    and shortcuts stay replicated: the factorization's ``mid`` widths
+    (:func:`factored_channels`) are not divisible by 2/4, and ``mid``
+    is always computed from the FULL feature count, so the
+    parameter-parity formula is untouched by sharding.
+    """
 
     features: int
     kernel: Tuple[int, int]       # (temporal extent, spatial extent)
     stride: Tuple[int, int] = (1, 1)  # (temporal, spatial)
     dtype: Any = jnp.bfloat16
+    shards: int = 1
+    shard_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -126,10 +167,21 @@ class SpatioTemporalConv(nn.Module):
         x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                          name="bn")(x)
         x = nn.relu(x)
-        x = nn.Conv(self.features, kernel_size=(t, 1, 1),
-                    strides=(st, 1, 1),
-                    padding=((pad_t, pad_t), (0, 0), (0, 0)),
-                    use_bias=False, dtype=self.dtype, name="temporal")(x)
+        if self.features % self.shards:
+            raise ValueError(
+                "shards=%d does not divide the temporal conv's %d "
+                "output channels" % (self.shards, self.features))
+        Conv = nn.Conv
+        if self.shards > 1:
+            Conv = nn.map_variables(
+                nn.Conv, "params",
+                trans_in_fn=_gather_shard_params(self.shard_axis,
+                                                 self.shards),
+                mutable=False)
+        x = Conv(self.features, kernel_size=(t, 1, 1),
+                 strides=(st, 1, 1),
+                 padding=((pad_t, pad_t), (0, 0), (0, 0)),
+                 use_bias=False, dtype=self.dtype, name="temporal")(x)
         return x
 
 
@@ -148,18 +200,24 @@ class SpatioTemporalResBlock(nn.Module):
     downsample: bool = False
     factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
+    shards: int = 1
+    shard_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         stride = 2 if self.downsample else 1
         res = SpatioTemporalConv(self.features, kernel=(3, 3),
                                  stride=(stride, stride), dtype=self.dtype,
+                                 shards=self.shards,
+                                 shard_axis=self.shard_axis,
                                  name="conv1")(x, train)
         res = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                            name="bn1")(res)
         res = nn.relu(res)
         res = SpatioTemporalConv(self.features, kernel=(3, 3),
-                                 dtype=self.dtype, name="conv2")(res, train)
+                                 dtype=self.dtype, shards=self.shards,
+                                 shard_axis=self.shard_axis,
+                                 name="conv2")(res, train)
         res = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                            name="bn2")(res)
 
@@ -167,6 +225,8 @@ class SpatioTemporalResBlock(nn.Module):
             if self.factored_shortcut:
                 x = SpatioTemporalConv(self.features, kernel=(1, 1),
                                        stride=(2, 2), dtype=self.dtype,
+                                       shards=self.shards,
+                                       shard_axis=self.shard_axis,
                                        name="shortcut")(x, train)
             else:
                 x = nn.Conv(self.features, kernel_size=(1, 1, 1),
@@ -185,15 +245,21 @@ class SpatioTemporalResLayer(nn.Module):
     downsample: bool = False
     factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
+    shards: int = 1
+    shard_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = SpatioTemporalResBlock(self.features,
                                    downsample=self.downsample,
                                    factored_shortcut=self.factored_shortcut,
-                                   dtype=self.dtype, name="block0")(x, train)
+                                   dtype=self.dtype, shards=self.shards,
+                                   shard_axis=self.shard_axis,
+                                   name="block0")(x, train)
         for i in range(1, self.num_blocks):
             x = SpatioTemporalResBlock(self.features, dtype=self.dtype,
+                                       shards=self.shards,
+                                       shard_axis=self.shard_axis,
                                        name="block%d" % i)(x, train)
         return x
 
@@ -214,6 +280,8 @@ class R2Plus1DNet(nn.Module):
     layer_sizes: Sequence[int] = R18_LAYER_SIZES
     factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
+    shards: int = 1
+    shard_axis: str = "tp"
 
     def __post_init__(self):
         super().__post_init__()
@@ -226,8 +294,9 @@ class R2Plus1DNet(nn.Module):
         for layer in range(self.start, self.end + 1):
             if layer == 1:
                 x = SpatioTemporalConv(64, kernel=(3, 7), stride=(1, 2),
-                                       dtype=self.dtype, name="conv1")(
-                                           x, train)
+                                       dtype=self.dtype, shards=self.shards,
+                                       shard_axis=self.shard_axis,
+                                       name="conv1")(x, train)
                 x = nn.BatchNorm(use_running_average=not train,
                                  dtype=self.dtype, name="stem_bn")(x)
                 x = nn.relu(x)
@@ -237,7 +306,8 @@ class R2Plus1DNet(nn.Module):
                     num_blocks=self.layer_sizes[layer - 2],
                     downsample=(layer >= 3),
                     factored_shortcut=self.factored_shortcut,
-                    dtype=self.dtype,
+                    dtype=self.dtype, shards=self.shards,
+                    shard_axis=self.shard_axis,
                     name="conv%d" % layer)(x, train)
         if self.end == NUM_LAYERS:
             x = jnp.mean(x, axis=(1, 2, 3))  # global spatiotemporal pool
@@ -258,15 +328,44 @@ class R2Plus1DClassifier(nn.Module):
     layer_sizes: Sequence[int] = R18_LAYER_SIZES
     factored_shortcut: bool = False
     dtype: Any = jnp.bfloat16
+    #: intra-stage tensor-parallel degree (shard_map only): the head's
+    #: kernel/bias live column-sharded at rest, are ring-gathered for
+    #: the full-width matmul (bitwise the unsharded logits), and each
+    #: member keeps only its own column block — so logits leave the
+    #: forward channel-sharded and the stage-level merge collective is
+    #: the one host-timed gather (rnb_tpu.parallel.shardplan): the
+    #: collective tax is measured, never buried inside the forward
+    shards: int = 1
+    shard_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = R2Plus1DNet(start=self.start, end=self.end,
                         layer_sizes=self.layer_sizes,
                         factored_shortcut=self.factored_shortcut,
-                        dtype=self.dtype,
+                        dtype=self.dtype, shards=self.shards,
+                        shard_axis=self.shard_axis,
                         name="net")(x, train)
         if self.end == NUM_LAYERS:
-            x = nn.Dense(self.num_classes, dtype=self.dtype,
-                         name="linear")(x)
+            if self.num_classes % self.shards:
+                raise ValueError(
+                    "shards=%d does not divide the %d-class head"
+                    % (self.shards, self.num_classes))
+            Dense = nn.Dense
+            if self.shards > 1:
+                Dense = nn.map_variables(
+                    nn.Dense, "params",
+                    trans_in_fn=_gather_shard_params(self.shard_axis,
+                                                     self.shards),
+                    mutable=False)
+            x = Dense(self.num_classes, dtype=self.dtype,
+                      name="linear")(x)
+            if self.shards > 1:
+                # keep only this member's column block: the slice is
+                # pure movement, so the merge gather reassembles the
+                # full-width logits bit-exactly
+                local = self.num_classes // self.shards
+                idx = lax.axis_index(self.shard_axis)
+                x = lax.dynamic_slice_in_dim(x, idx * local, local,
+                                             axis=-1)
         return x.astype(jnp.float32)

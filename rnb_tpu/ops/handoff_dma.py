@@ -23,6 +23,20 @@ ever materializing host memory. This module owns the *how*:
   target shardings, the shift ``k`` that turns one placement into the
   other, or ``None`` when the move is not a ring shift (then
   ``device_put`` is the honest path).
+* :func:`ring_all_gather` / :func:`ring_psum_scatter` — the intra-stage
+  sharding collectives (rnb_tpu.parallel.shardplan): both are built on
+  the SAME one-step ring movement as :func:`ring_shift` — n-1 neighbor
+  hops, each hop the Pallas remote-DMA kernel on real TPU or the
+  ``lax.ppermute`` twin everywhere else — composed with local
+  slice/update (gather) or slice/add (reduce-scatter) arithmetic.
+  The all-gather is pure data movement (chunk placement), so its
+  result is BITWISE the concatenation of the shards — the property
+  the sharded stage forward's logit bit-parity rests on. The
+  reduce-scatter adds in ring order, which is a *different* float
+  summation order than a tree psum; it is shipped for the TPU
+  reduction path and pinned against a jnp reference on exactly
+  representable values (tests/test_handoff.py), never used where
+  bit-parity against an unsharded forward is claimed.
 
 Kernel lineage: the Pallas distributed right-permute exemplar
 (SNIPPETS.md [1]/[3]; jax.dev pallas/tpu/distributed) — semaphore
@@ -138,6 +152,171 @@ def _ppermute_shift_body(axis_name: str, n: int, shift: int):
         return lax.ppermute(x_shard, axis_name, perm)
 
     return body
+
+
+def _one_step_shift_body(axis_name: str, n: int, use_pallas: bool):
+    """The shared ring primitive both collectives below ride: move
+    every core's buffer to its +1 neighbor — the Pallas remote-DMA
+    kernel on real TPU, the ppermute twin everywhere else."""
+    return (_pallas_shift_body(axis_name, n, 1) if use_pallas
+            else _ppermute_shift_body(axis_name, n, 1))
+
+
+def ring_all_gather_body(axis_name: str, n: int, axis: int = -1,
+                         use_pallas: bool = False):
+    """Per-core body (usable inside an enclosing ``shard_map``): local
+    shard -> the full concatenation along ``axis``, assembled by n-1
+    one-step ring hops. Pure movement — each global chunk lands at
+    ``chunk_index * chunk`` exactly once, so the result is bitwise the
+    unsharded array on every core."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    shift = _one_step_shift_body(axis_name, n, use_pallas)
+
+    def body(x_shard):
+        if n == 1:
+            return x_shard
+        ax = axis % x_shard.ndim
+        chunk = x_shard.shape[ax]
+        idx = lax.axis_index(axis_name)
+        full = list(x_shard.shape)
+        full[ax] = chunk * n
+        out = lax.dynamic_update_slice_in_dim(
+            jnp.zeros(full, x_shard.dtype), x_shard, idx * chunk,
+            axis=ax)
+        buf = x_shard
+        for s in range(1, n):
+            buf = shift(buf)
+            # after s hops this core holds the shard that started on
+            # core (idx - s) mod n — place it at that chunk's offset
+            src = lax.rem(idx - s + n, n)
+            out = lax.dynamic_update_slice_in_dim(out, buf, src * chunk,
+                                                  axis=ax)
+        return out
+
+    return body
+
+
+def ring_psum_scatter_body(axis_name: str, n: int, axis: int = -1,
+                           use_pallas: bool = False):
+    """Per-core body: full-width local operand -> this core's chunk of
+    the cross-core elementwise sum (``lax.psum_scatter`` semantics),
+    as n-1 one-step ring hops each followed by one local chunk add.
+    Ring order sums left-to-right around the ring — a different float
+    association than a tree reduction (see module docstring)."""
+    from jax import lax
+
+    shift = _one_step_shift_body(axis_name, n, use_pallas)
+
+    def body(x_local):
+        ax = axis % x_local.ndim
+        width = x_local.shape[ax]
+        if width % n:
+            raise ValueError(
+                "ring_psum_scatter: axis %d extent %d not divisible "
+                "by %d ring members" % (ax, width, n))
+        if n == 1:
+            return x_local
+        chunk = width // n
+        idx = lax.axis_index(axis_name)
+
+        def piece(m):
+            return lax.dynamic_slice_in_dim(x_local, m * chunk, chunk,
+                                            axis=ax)
+
+        # the accumulator seeded on core j ends on core j+n-1 carrying
+        # chunk (j-1) mod n the whole way: core j seeds chunk j-1, and
+        # at hop s adds chunk (j-1-s) mod n to the partial it received
+        acc = piece(lax.rem(idx - 1 + n, n))
+        for s in range(1, n):
+            acc = shift(acc)
+            acc = acc + piece(lax.rem(idx - 1 - s + 2 * n, n))
+        return acc
+
+    return body
+
+
+def ring_all_gather(x, mesh, axis_name: Optional[str] = None,
+                    axis: int = -1, use_pallas: Optional[bool] = None):
+    """Standalone entry: ``x`` sharded along ``axis`` over the mesh
+    ring -> the same *value* fully replicated on every core (bitwise
+    the unsharded array). ``use_pallas`` defaults to
+    :func:`dma_available`."""
+    jax, _ = _jax_numpy()
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:
+        shard_map = jax.shard_map
+    from jax.sharding import PartitionSpec
+
+    if axis_name is None:
+        axis_name = _mesh_axis(mesh)
+        if axis_name is None:
+            raise ValueError("ring_all_gather needs a single-axis mesh "
+                             "or an explicit axis_name")
+    n = int(mesh.shape[axis_name])
+    ax = axis % x.ndim
+    if x.shape[ax] % n:
+        raise ValueError(
+            "ring_all_gather: axis %d extent %d not divisible by %d "
+            "ring members" % (ax, x.shape[ax], n))
+    if use_pallas is None:
+        use_pallas = dma_available()
+    in_spec = [None] * x.ndim
+    in_spec[ax] = axis_name
+    fn = shard_map(ring_all_gather_body(axis_name, n, axis=ax,
+                                        use_pallas=use_pallas),
+                   mesh=mesh, in_specs=PartitionSpec(*in_spec),
+                   out_specs=PartitionSpec(), check_rep=False)
+    return jax.jit(fn)(x)
+
+
+def ring_psum_scatter(x, mesh, axis_name: Optional[str] = None,
+                      axis: int = -1,
+                      use_pallas: Optional[bool] = None):
+    """Standalone entry: ``x`` carries one full-width operand per core
+    stacked on axis 0 (global shape ``(n, ...)``); returns the
+    cross-core elementwise sum scattered along ``axis`` of the operand
+    — core i holds chunk i, i.e. ``lax.psum_scatter`` over the ring.
+    The returned global array is the concatenation of those chunks
+    (== the full sum)."""
+    jax, _ = _jax_numpy()
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:
+        shard_map = jax.shard_map
+    from jax.sharding import PartitionSpec
+
+    if axis_name is None:
+        axis_name = _mesh_axis(mesh)
+        if axis_name is None:
+            raise ValueError("ring_psum_scatter needs a single-axis "
+                             "mesh or an explicit axis_name")
+    n = int(mesh.shape[axis_name])
+    if x.shape[0] != n:
+        raise ValueError(
+            "ring_psum_scatter: leading axis %d must equal the %d ring "
+            "members (one operand per core)" % (x.shape[0], n))
+    op_axis = (axis % (x.ndim - 1)) + 1  # operand axis in the stacked x
+    if x.shape[op_axis] % n:
+        raise ValueError(
+            "ring_psum_scatter: axis %d extent %d not divisible by %d "
+            "ring members" % (op_axis - 1, x.shape[op_axis], n))
+    if use_pallas is None:
+        use_pallas = dma_available()
+    inner = ring_psum_scatter_body(axis_name, n, axis=axis,
+                                   use_pallas=use_pallas)
+
+    def body(x_stack):  # local (1, ...) slab -> this core's sum chunk
+        return inner(x_stack[0])
+
+    out_spec = [None] * (x.ndim - 1)
+    out_spec[op_axis - 1] = axis_name
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=PartitionSpec(axis_name),
+                   out_specs=PartitionSpec(*out_spec), check_rep=False)
+    return jax.jit(fn)(x)
 
 
 def ring_shift(x, mesh, axis_name: Optional[str] = None, shift: int = 1,

@@ -33,6 +33,36 @@ predicted bottleneck occupancy. First-order by design: it ignores
 queueing variance and host-side coupling, which is why the prediction
 is *checked*, not trusted.
 
+Intra-stage sharding (PR 19) makes the plan two-dimensional: a step
+may run at ``shard degree`` k (rnb_tpu.parallel.shardplan), consuming
+k devices *per replica*. The planner's original model silently
+assumed per-step service is invariant to the plan — true for replica
+scaling (lanes run whole independent dispatches) but WRONG for
+sharding, whose service includes a measured collective slice (the
+``exec{i}.collective`` merge gather) that exists only because of the
+degree. The corrected model, per step:
+
+* **replicated** steps keep lane-parallel semantics: service is
+  plan-invariant, occupancy at n replicas = ``L_i / n``;
+* **sharded** steps decompose service into compute + collective. The
+  compute slice is degree-invariant (weight-gathered sharding
+  replicates the math; degree divides parameter *residency*, not
+  FLOPs — see shardplan), and the collective slice scales with the
+  ring-hop factor ``g(k) = (k-1)/k``, *calibrated from the measured
+  collective fraction, never assumed*. With no measured collective
+  (executed degree 1) there is nothing to calibrate from, so the
+  planner refuses to extrapolate a degree>1 service — that
+  counterfactual belongs to `whatif`'s ``shard_degree_step<i>``
+  vocabulary, validated against an executed shard arm.
+
+Joint recommendation (:func:`recommend_joint`): degree is bought for
+per-device HBM feasibility, never for speed — on this cost model a
+higher degree only adds collective tax — so each step's degree is the
+smallest its memory floor (``min_degree``, from the stage's armed
+feasibility gate) allows, with the calibrated compute-only service
+when that drops the degree below the executed one; replicas then
+spread greedily, each costing ``degree`` devices.
+
 Config (root key, validated in rnb_tpu.config)::
 
     "placement": {"mode": "plan"}                         // report only
@@ -85,19 +115,36 @@ class CostRecord:
     step_idx: int
     busy_s: float
     dispatches: int
+    #: executed shard degree: 0 = the step declared no `shard` key,
+    #: >= 1 = the declared degree (1 included, so an operator
+    #: iterating degrees keeps a stable report shape)
+    shard_degree: int = 0
+    #: host-timed exec{i}.collective seconds (merge gathers), a slice
+    #: OF busy_s — the calibration source for degree counterfactuals
+    collective_s: float = 0.0
+    #: smallest degree the stage's armed HBM feasibility gate admits
+    #: (1 when no budget was declared — no documented memory floor)
+    min_degree: int = 1
 
 
 def aggregate_costs(records: Sequence) -> Dict[int, Dict[str, float]]:
     """Per-step sums over the executors' cost records:
-    {step_idx: {instances, busy_s, dispatches}}."""
+    {step_idx: {instances, busy_s, dispatches, shard_degree,
+    collective_s, min_degree}}."""
     out: Dict[int, Dict[str, float]] = {}
     for rec in records:
         step = out.setdefault(int(rec.step_idx),
                               {"instances": 0, "busy_s": 0.0,
-                               "dispatches": 0})
+                               "dispatches": 0, "shard_degree": 0,
+                               "collective_s": 0.0, "min_degree": 1})
         step["instances"] += 1
         step["busy_s"] += float(rec.busy_s)
         step["dispatches"] += int(rec.dispatches)
+        step["shard_degree"] = max(step["shard_degree"],
+                                   int(getattr(rec, "shard_degree", 0)))
+        step["collective_s"] += float(getattr(rec, "collective_s", 0.0))
+        step["min_degree"] = max(step["min_degree"],
+                                 int(getattr(rec, "min_degree", 1)))
     return out
 
 
@@ -121,6 +168,94 @@ def recommend(loads: Dict[int, float], device_budget: int
     return n
 
 
+def ring_hop_factor(degree: int) -> float:
+    """``g(k) = (k-1)/k`` — the fraction of the gathered bytes a
+    degree-k ring moves (k-1 one-step hops of 1/k-sized chunks).
+    The collective slice of a sharded step's service scales with this
+    factor; g(1) = 0 (no ring, no tax)."""
+    degree = int(degree)
+    return 0.0 if degree <= 1 else (degree - 1) / degree
+
+
+def service_at_degree(service_s: float, collective_s: float,
+                      degree0: int, degree: int) -> Optional[float]:
+    """Per-dispatch service predicted at ``degree``, calibrated from
+    the measurement at ``degree0``: the compute slice is invariant
+    (weight-gathered sharding), the collective slice scales by
+    ``g(degree)/g(degree0)``. Returns None when ``degree0 <= 1`` and
+    ``degree > 1`` — a degree-1 run measured NO collective, and this
+    module refuses to invent one (whatif documents the same limit on
+    its ``shard_degree_step<i>`` vocabulary)."""
+    degree0, degree = int(degree0), int(degree)
+    if degree == degree0:
+        return float(service_s)
+    g0 = ring_hop_factor(degree0)
+    if g0 <= 0.0:
+        if degree <= 1:
+            return float(service_s)
+        return None
+    compute = max(0.0, float(service_s) - float(collective_s))
+    return compute + float(collective_s) * ring_hop_factor(degree) / g0
+
+
+def recommend_joint(loads: Dict[int, float], device_budget: int,
+                    degrees: Dict[int, int],
+                    collective_loads: Dict[int, float],
+                    min_degrees: Dict[int, int]) -> Dict[int, Dict]:
+    """Greedy min-bottleneck plan over (replicas x shard degree) under
+    ``sum_i n_i * k_i <= device_budget``.
+
+    Degree choice is analytic on this cost model: a higher degree only
+    ever *adds* collective tax (compute is degree-invariant under
+    weight-gathered sharding) while costing more devices per replica,
+    so each step takes the smallest degree its memory floor
+    (``min_degrees``) admits — the executed degree when the floor
+    binds, degree 1 (shedding the whole measured collective slice,
+    a calibrated drop, not an assumed one) when it does not. Replicas
+    then spread greedily exactly like :func:`recommend`, except each
+    replica of step i costs ``k_i`` devices; a step whose ring no
+    longer fits the spare budget is skipped for the next-hottest.
+
+    Returns ``{step: {"replicas", "shard_degree", "load"}}``.
+    """
+    steps = sorted(loads)
+    if not steps:
+        return {}
+    plan: Dict[int, Dict] = {}
+    for s in steps:
+        d0 = max(1, int(degrees.get(s, 1)))
+        floor = max(1, int(min_degrees.get(s, 1)))
+        d = d0 if floor > 1 else 1
+        if d == d0:
+            load = float(loads[s])
+        else:
+            # calibrated compute-only load at degree 1: shed the
+            # measured collective slice
+            load = max(0.0,
+                       float(loads[s]) - float(collective_loads.get(
+                           s, 0.0)))
+        plan[s] = {"replicas": 1, "shard_degree": d, "load": load}
+    spare = int(device_budget) - sum(p["shard_degree"]
+                                     for p in plan.values())
+    while spare > 0:
+        order = sorted(
+            steps,
+            key=lambda s: (-(plan[s]["load"] / plan[s]["replicas"]), s))
+        gave = False
+        for s in order:
+            p = plan[s]
+            if p["load"] <= 0.0:
+                break
+            if p["shard_degree"] <= spare:
+                p["replicas"] += 1
+                spare -= p["shard_degree"]
+                gave = True
+                break
+        if not gave:
+            break
+    return plan
+
+
 def build_report(records: Sequence, wall_s: float, device_budget: int,
                  mode: str) -> Optional[Dict[str, object]]:
     """The ``Placement:`` log-meta payload for one finished run: the
@@ -132,6 +267,10 @@ def build_report(records: Sequence, wall_s: float, device_budget: int,
         return None
     steps: Dict[str, Dict[str, object]] = {}
     loads: Dict[int, float] = {}
+    degrees: Dict[int, int] = {}
+    collective_loads: Dict[int, float] = {}
+    min_degrees: Dict[int, int] = {}
+    sharded = False
     for step_idx in sorted(costs):
         c = costs[step_idx]
         dispatches = int(c["dispatches"])
@@ -141,7 +280,7 @@ def build_report(records: Sequence, wall_s: float, device_budget: int,
         rate_hz = dispatches / wall_s
         load = rate_hz * service_s
         loads[step_idx] = load
-        steps["step%d" % step_idx] = {
+        row: Dict[str, object] = {
             "instances": instances,
             "dispatches": dispatches,
             "service_ms": round(service_s * 1000.0, 3),
@@ -151,13 +290,40 @@ def build_report(records: Sequence, wall_s: float, device_budget: int,
             "occupancy": round(load / instances if instances else 0.0,
                                4),
         }
-    plan = recommend(loads, device_budget)
+        degree = int(c.get("shard_degree", 0))
+        degrees[step_idx] = max(1, degree)
+        min_degrees[step_idx] = int(c.get("min_degree", 1))
+        coll_s = float(c.get("collective_s", 0.0))
+        collective_loads[step_idx] = (coll_s / dispatches * rate_hz
+                                      if dispatches else 0.0)
+        if degree > 0:
+            # shard-declared step: service_ms above already CONTAINS
+            # the collective slice (the corrected service model), and
+            # the slice is reported so the calibration is inspectable
+            sharded = True
+            row["shard_degree"] = degree
+            row["collective_ms"] = round(
+                (coll_s / dispatches if dispatches else 0.0) * 1000.0,
+                3)
+        steps["step%d" % step_idx] = row
+    if sharded:
+        joint = recommend_joint(loads, device_budget, degrees,
+                                collective_loads, min_degrees)
+        plan_out = {"step%d" % s: {
+            "replicas": joint[s]["replicas"],
+            "shard_degree": joint[s]["shard_degree"],
+            "occupancy": round(joint[s]["load"]
+                               / joint[s]["replicas"], 4)}
+            for s in sorted(joint)}
+    else:
+        plan = recommend(loads, device_budget)
+        plan_out = {"step%d" % s: {
+            "replicas": plan[s],
+            "occupancy": round(loads[s] / plan[s], 4)}
+            for s in sorted(plan)}
     return {
         "mode": mode,
         "device_budget": int(device_budget),
         "steps": steps,
-        "plan": {"step%d" % s: {
-            "replicas": plan[s],
-            "occupancy": round(loads[s] / plan[s], 4)}
-            for s in sorted(plan)},
+        "plan": plan_out,
     }

@@ -183,6 +183,10 @@ class RunnerContext:
     #: ragged stages (root 'ragged' config key) append their
     #: ragged_stats here (BenchmarkResult ragged_* + `Ragged:` line)
     ragged_sink: Optional[List] = None
+    #: shard-declared stages (step `shard` key,
+    #: rnb_tpu.parallel.shardplan) append ``(step_idx, shard_stats)``
+    #: here (BenchmarkResult shard_* + `Shard:`/`Shard steps:` lines)
+    shard_sink: Optional[List] = None
     #: per-job rnb_tpu.trace.Tracer when the config's `trace` key
     #: enabled tracing, else None. The executor emits hot-loop spans
     #: through the module-level trace hooks (one None test when off),
@@ -736,6 +740,13 @@ def runner(ctx: RunnerContext) -> None:
             # sampled occupancy sources wire themselves up here; the
             # executor's own spans need no stage support
             model.enable_trace(ctx.tracer, ctx.step_idx)
+        if hasattr(model, "bind_shard_step"):
+            # intra-stage sharding (rnb_tpu.parallel.shardplan): the
+            # stage host-times its merge collective as
+            # exec{i}.collective — unconditional (unlike enable_trace)
+            # because hostprof and the Shard: accounting need the
+            # step index even on trace-disabled runs
+            model.bind_shard_step(ctx.step_idx)
         # live-metrics plane (rnb_tpu.metrics): stage-owned subsystems
         # (clip cache, staging pool, handoff edge) become poll sources
         # of the active registry — registered before the start barrier
@@ -1616,6 +1627,16 @@ def runner(ctx: RunnerContext) -> None:
                 ctx.ragged_sink.append(dict(model.ragged_stats))
             except Exception:
                 traceback.print_exc()
+        # intra-stage shard accounting (rnb_tpu.parallel.shardplan):
+        # stages with a declared `shard` key report degree, projected
+        # footprint and the host-timed collective tax
+        if (ctx.shard_sink is not None
+                and getattr(model, "shard_stats", None) is not None):
+            try:
+                ctx.shard_sink.append((ctx.step_idx,
+                                       dict(model.shard_stats)))
+            except Exception:
+                traceback.print_exc()
         # replica-lane settlement for an item still in service when
         # the loop exited (abort / target-reached break); the hedge
         # governor needs no twin here — claim() settles on every
@@ -1640,9 +1661,24 @@ def runner(ctx: RunnerContext) -> None:
         # only (the sink gates it)
         if ctx.placement_sink is not None and model is not None:
             try:
-                ctx.placement_sink.append(
-                    CostRecord(ctx.step_idx, stage_busy_s,
-                               stage_dispatches))
+                sstats = getattr(model, "shard_stats", None)
+                if sstats is not None:
+                    # sharded steps carry their degree, the host-timed
+                    # collective slice and the feasibility floor so
+                    # the planner's joint (replicas x degree) model
+                    # calibrates from measurement, never assumption
+                    ctx.placement_sink.append(
+                        CostRecord(ctx.step_idx, stage_busy_s,
+                                   stage_dispatches,
+                                   shard_degree=int(sstats["degree"]),
+                                   collective_s=float(
+                                       sstats["collective_ms"]) / 1e3,
+                                   min_degree=max(
+                                       1, int(sstats["min_degree"]))))
+                else:
+                    ctx.placement_sink.append(
+                        CostRecord(ctx.step_idx, stage_busy_s,
+                                   stage_dispatches))
             except Exception:
                 traceback.print_exc()
         try:

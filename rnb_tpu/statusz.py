@@ -112,13 +112,15 @@ def parse_whatif_query(query: str) -> Dict[str, object]:
     """``/whatif`` query string -> the WhatIfModel.query spec.
 
     Vocabulary (mirroring rnb_tpu.whatif exactly):
-    ``replicas_step<i>=<n|+k|-k>`` and ``service_scale_step<i>=<f>``
-    (one pair per step), ``arrival_scale=<f>``, ``pool_rows=<n>``.
-    Unknown keys raise ValueError so a typo'd probe fails loudly
-    (400), never as a silently-ignored knob."""
+    ``replicas_step<i>=<n|+k|-k>``, ``service_scale_step<i>=<f>`` and
+    ``shard_degree_step<i>=<k>`` (one per step),
+    ``arrival_scale=<f>``, ``pool_rows=<n>``. Unknown keys raise
+    ValueError so a typo'd probe fails loudly (400), never as a
+    silently-ignored knob."""
     spec: Dict[str, object] = {}
     replicas: Dict[str, object] = {}
     service_scale: Dict[str, float] = {}
+    shard_degree: Dict[str, int] = {}
     for key, values in urllib.parse.parse_qs(
             query, keep_blank_values=True).items():
         value = values[-1]
@@ -140,6 +142,13 @@ def parse_whatif_query(query: str) -> Dict[str, object]:
         elif key.startswith("service_scale_step") \
                 and key[len("service_scale_step"):].isdigit():
             service_scale[key[len("service_scale_"):]] = float(value)
+        elif key.startswith("shard_degree_step") \
+                and key[len("shard_degree_step"):].isdigit():
+            degree = int(value)
+            if degree < 1:
+                raise ValueError(
+                    "shard degree must be >= 1, got %d" % degree)
+            shard_degree[key[len("shard_degree_"):]] = degree
         elif key == "arrival_scale":
             spec[key] = float(value)
         elif key == "pool_rows":
@@ -148,11 +157,14 @@ def parse_whatif_query(query: str) -> Dict[str, object]:
             raise ValueError(
                 "unknown whatif parameter %r (known: "
                 "replicas_step<i>, service_scale_step<i>, "
-                "arrival_scale, pool_rows)" % key)
+                "shard_degree_step<i>, arrival_scale, pool_rows)"
+                % key)
     if replicas:
         spec["replicas"] = replicas
     if service_scale:
         spec["service_scale"] = service_scale
+    if shard_degree:
+        spec["shard_degree"] = shard_degree
     return spec
 
 

@@ -205,6 +205,17 @@ META_LINE_REGISTRY = (
               "ragged row-pool dispatch counters: pool capacity, "
               "emissions, valid rows, pad rows the bucketed rule "
               "would have shipped (ragged-enabled runs only)"),
+    StampSpec("Shard:", "rnb_tpu/benchmark.py",
+              "intra-stage shard counters: declared-degree steps, max "
+              "degree, logits-path merge gathers, their summed "
+              "host-timed microseconds, valid rows crossing sharded "
+              "stages (declared-shard runs only; --check holds "
+              "degree x replicas <= the device budget and "
+              "collective_us <= the inference span sum)"),
+    StampSpec("Shard steps:", "rnb_tpu/benchmark.py",
+              "JSON per-step shard detail: degree/axis, merge-gather "
+              "counters, projected vs budget per-device MiB, min "
+              "feasible degree (declared-shard runs only)"),
     StampSpec("Padding:", "rnb_tpu/benchmark.py",
               "bucketed-path padding waste: pad rows / total shipped "
               "rows / emissions summed over batching stages"),
@@ -425,6 +436,12 @@ TRACE_EVENT_REGISTRY = (
               "span: an evicted replica lane's executor re-enqueues "
               "one queued-but-undispatched item onto a healthy "
               "sibling lane (health-enabled chaos runs only)"),
+    StampSpec("exec{step}.collective", "rnb_tpu/models/r2p1d/model.py",
+              "span: the sharded stage's cross-shard logits merge "
+              "gather, host-timed around the separate merge jit "
+              "(declared shard_degree > 1 only; nested inside the "
+              "step's model_call span — the collective tax, never "
+              "extra wall)"),
     StampSpec("health.lane_state", "rnb_tpu/health.py",
               "instant: a replica lane's health state transition "
               "(args: lane, from, to, why) — the timeline face of "
@@ -503,6 +520,8 @@ METRIC_REGISTRY = (
                "device output readiness wait (ms)"),
     MetricSpec("exec{step}.publish", "histogram", "bridge",
                "route + ring write + downstream enqueue (ms)"),
+    MetricSpec("exec{step}.collective", "histogram", "bridge",
+               "sharded-stage cross-shard logits merge gather (ms)"),
     MetricSpec("loader.emit", "histogram", "bridge",
                "fused-batch take/assemble/handoff (ms)"),
     MetricSpec("loader.transfer", "histogram", "bridge",

@@ -75,12 +75,14 @@ class StageCalib:
     """One pipeline stage's calibrated queueing parameters."""
 
     __slots__ = ("step", "lanes", "dispatches", "service_ms",
-                 "service_m2_ms2", "injected_ms", "rows_cap")
+                 "service_m2_ms2", "injected_ms", "rows_cap",
+                 "collective_ms", "shard_degree")
 
     def __init__(self, step: int, lanes: int, dispatches: int,
                  service_ms: float, service_m2_ms2: float = 0.0,
                  injected_ms: float = 0.0,
-                 rows_cap: Optional[int] = None):
+                 rows_cap: Optional[int] = None,
+                 collective_ms: float = 0.0, shard_degree: int = 1):
         self.step = int(step)
         self.lanes = max(1, int(lanes))
         self.dispatches = max(0, int(dispatches))
@@ -95,6 +97,15 @@ class StageCalib:
         #: row capacity per dispatch (ragged pool_rows), for pool
         #: queries; None = not a pooled stage
         self.rows_cap = rows_cap
+        #: mean per-dispatch collective tax (ms) — the measured
+        #: ``exec{i}.collective`` merge wall. It is NOT added to
+        #: service_ms (the merge span nests inside model_call, so the
+        #: service histograms already count it); it is the measured
+        #: slice shard-degree queries rescale.
+        self.collective_ms = float(collective_ms)
+        #: the degree the run was calibrated at (config-declared;
+        #: 1 = unsharded)
+        self.shard_degree = max(1, int(shard_degree))
 
     @property
     def host_ms(self) -> float:
@@ -131,6 +142,8 @@ class WhatIfModel:
                     in dict(overrides.get("replicas", {})).items()}
         scales = {_step_idx(k): float(v) for k, v
                   in dict(overrides.get("service_scale", {})).items()}
+        shard = {_step_idx(k): max(1, int(v)) for k, v
+                 in dict(overrides.get("shard_degree", {})).items()}
         pool_rows = overrides.get("pool_rows")
         out = []
         for stage in self.stages:
@@ -141,7 +154,26 @@ class WhatIfModel:
                     lanes = max(1, lanes + int(spec))
                 else:
                     lanes = max(1, int(spec))
-            service = stage.service_ms * scales.get(stage.step, 1.0)
+            service_base = stage.service_ms
+            if stage.step in shard:
+                # shard-degree counterfactual: rescale ONLY the
+                # measured collective slice by the ring-hop factor
+                # ratio g(k)/g(d0), g(k) = (k-1)/k — the compute slice
+                # is degree-invariant (weight-gathered sharding divides
+                # parameter residency, not FLOPs). Calibrated at
+                # degree 1 there is no measured collective slice
+                # (collective_ms == 0), so the model honestly predicts
+                # no tax rather than inventing one it never measured —
+                # validate degree-1 -> k predictions against an
+                # executed arm, never trust them.
+                from rnb_tpu.placement import ring_hop_factor
+                g0 = ring_hop_factor(stage.shard_degree)
+                if g0 > 0.0 and stage.collective_ms > 0.0:
+                    gk = ring_hop_factor(shard[stage.step])
+                    service_base = (stage.service_ms
+                                    - stage.collective_ms
+                                    + stage.collective_ms * (gk / g0))
+            service = service_base * scales.get(stage.step, 1.0)
             dispatches = stage.dispatches
             if pool_rows and stage.rows_cap:
                 # first-order: requests-per-dispatch scales with the
@@ -340,8 +372,20 @@ def steps_info_from_config(raw: Mapping[str, object]
         lanes = sum(len(g.get("devices") or g.get("gpus") or [])
                     for g in step.get("queue_groups", [])
                     if isinstance(g, dict)) or 1
+        shard = step.get("shard")
+        shard_degree = 1
+        if isinstance(shard, dict):
+            try:
+                shard_degree = max(1, int(shard.get("degree", 1)))
+            except (TypeError, ValueError):
+                shard_degree = 1
+            # a shard ring is one executable over degree devices, not
+            # degree executors — the as-written device list counts
+            # replicas x degree entries, but only replicas lanes exist
+            lanes = max(1, lanes // shard_degree)
         info[step_idx] = {"lanes": lanes, "injected_ms": 0.0,
-                          "rows_cap": pool_rows}
+                          "rows_cap": pool_rows,
+                          "shard_degree": shard_degree}
     plan = raw.get("fault_plan") if isinstance(raw, dict) else None
     faults = dict(plan or {}).get("faults", [])
     for fault in faults or []:
@@ -356,6 +400,10 @@ def steps_info_from_config(raw: Mapping[str, object]
 
 
 _SPAN_RE = re.compile(r"^exec(\d+)\.(model_call|device_sync)$")
+#: the shard merge span — parsed SEPARATELY from the service spans:
+#: it nests inside model_call, so adding it to sum_ms would count the
+#: collective tax twice
+_COLL_RE = re.compile(r"^exec(\d+)\.collective$")
 
 
 def calibrate_from_snapshot(snapshot: Mapping[str, object],
@@ -378,7 +426,14 @@ def calibrate_from_snapshot(snapshot: Mapping[str, object],
     if requests is None:
         requests = int(counters.get("slo.tracked", 0))
     per_step: Dict[int, Dict[str, object]] = {}
+    coll_sum_ms: Dict[int, float] = {}
     for name, hist in hists.items():
+        cm = _COLL_RE.match(str(name))
+        if cm is not None:
+            coll_sum_ms[int(cm.group(1))] = \
+                coll_sum_ms.get(int(cm.group(1)), 0.0) \
+                + float(dict(hist).get("sum_ms", 0.0))
+            continue
         m = _SPAN_RE.match(str(name))
         if m is None:
             continue
@@ -409,7 +464,9 @@ def calibrate_from_snapshot(snapshot: Mapping[str, object],
             dispatches=dispatches, service_ms=service_ms,
             service_m2_ms2=m2,
             injected_ms=float(info.get("injected_ms", 0.0)),
-            rows_cap=info.get("rows_cap")))
+            rows_cap=info.get("rows_cap"),
+            collective_ms=coll_sum_ms.get(step, 0.0) / dispatches,
+            shard_degree=int(info.get("shard_degree", 1) or 1)))
     return WhatIfModel(stages, requests=requests, wall_s=wall_s,
                        arrival_hz=arrival_hz)
 

@@ -109,6 +109,23 @@ def parse_meta(job_dir: str) -> Dict[str, object]:
             for part in line.split(":", 1)[1].split():
                 key, _, val = part.partition("=")
                 meta["ragged_" + key] = int(val)
+        elif line.startswith("Shard steps:"):
+            # JSON per-step shard detail {step: {degree, axis,
+            # gathers, collective_us, rows, projected_mb, budget_mb,
+            # min_degree}} — must be matched before the "Shard:"
+            # prefix below; declared-shard runs only
+            import json
+            meta["shard_step_detail"] = json.loads(
+                line.split(":", 1)[1])
+        elif line.startswith("Shard:"):
+            # "Shard: steps=S max_degree=D gathers=G collective_us=C
+            #  rows=R" — intra-stage shard accounting
+            # (rnb_tpu.parallel.shardplan), declared-shard runs only;
+            # --check holds degree x replicas to the device budget and
+            # collective_us under the inference span sum
+            for part in line.split(":", 1)[1].split():
+                key, _, val = part.partition("=")
+                meta["shard_" + key] = int(val)
         elif line.startswith("Padding:"):
             # "Padding: pad_rows=P total_rows=T pad_emissions=E" —
             # padding-waste counters over every batching stage
@@ -1120,6 +1137,10 @@ def check_job_detail(job_dir: str) -> Tuple[List[str], bool]:
     # plan's predicted occupancy must agree with the busy fraction
     # the trace timeline actually recorded
     problems.extend(_check_placement(job_dir, meta))
+    # intra-stage sharding (rnb_tpu.parallel.shardplan): totals foot
+    # the per-step detail, rings fit the config's device budget, and
+    # the collective tax nests inside the inference spans it rides
+    problems.extend(_check_shard(job_dir, meta))
     # phase attribution (rnb_tpu.trace): the stamp-only decomposition
     # must partition every request's end-to-end span, cover every
     # steady row once per phase, and agree across its three surfaced
@@ -1583,6 +1604,127 @@ def _check_placement(job_dir: str,
                 "from what the executors measured"
                 % (key, pred, traced,
                    int(_OCCUPANCY_REL_TOL * 100), _OCCUPANCY_ABS_TOL))
+    return problems
+
+
+def _check_shard(job_dir: str, meta: Dict[str, object]) -> List[str]:
+    """'Shard:' ledger invariants: the totals must foot the per-step
+    detail, every declared ring must fit the step's written device
+    budget (degree x replicas <= listed devices), a running stage must
+    sit inside its declared HBM budget (over-budget configs are
+    launch-rejected, so a line showing one is a contradiction), and
+    the merge collective must nest inside the inference spans it
+    rides (traced collective wall <= traced model_call wall)."""
+    problems: List[str] = []
+    if "shard_steps" not in meta:
+        return problems
+    detail = {str(k): dict(v) for k, v
+              in dict(meta.get("shard_step_detail") or {}).items()}
+    if len(detail) != meta.get("shard_steps", 0):
+        problems.append(
+            "'Shard:' says steps=%s but 'Shard steps:' details %d "
+            "step(s)" % (meta.get("shard_steps"), len(detail)))
+    for key, total_key in (("gathers", "shard_gathers"),
+                           ("collective_us", "shard_collective_us"),
+                           ("rows", "shard_rows")):
+        want = sum(int(d.get(key, 0)) for d in detail.values())
+        if int(meta.get(total_key, 0)) != want:
+            problems.append(
+                "'Shard:' %s=%s but the per-step details sum to %d"
+                % (key, meta.get(total_key), want))
+    if detail:
+        want = max(int(d.get("degree", 0)) for d in detail.values())
+        if int(meta.get("shard_max_degree", 0)) != want:
+            problems.append(
+                "'Shard:' max_degree=%s but the per-step details max "
+                "to %d" % (meta.get("shard_max_degree"), want))
+    for step_key, d in sorted(detail.items()):
+        for key in ("gathers", "collective_us", "rows"):
+            if int(d.get(key, 0)) < 0:
+                problems.append("negative shard %s on step %s"
+                                % (key, step_key))
+        if int(d.get("degree", 0)) < 1:
+            problems.append(
+                "'Shard steps:' step %s shows degree %s (a declared "
+                "stage runs at least degree 1)"
+                % (step_key, d.get("degree")))
+        budget = float(d.get("budget_mb") or 0.0)
+        projected = float(d.get("projected_mb") or 0.0)
+        if budget and projected > budget:
+            problems.append(
+                "'Shard steps:' step %s projects %.1f MiB over its "
+                "%.1f MiB budget — an over-budget stage is "
+                "launch-rejected, so this line cannot come from a "
+                "completed run" % (step_key, projected, budget))
+    # ring vs the written device budget (the config copy benchmark.py
+    # drops into the job dir keeps the as-written, pre-expansion form)
+    import json
+    for name in sorted(os.listdir(job_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(job_dir, name)) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(raw, dict) or "pipeline" not in raw:
+            continue
+        for step_idx, step in enumerate(raw["pipeline"]):
+            shard = (step.get("shard")
+                     if isinstance(step, dict) else None)
+            if not isinstance(shard, dict):
+                continue
+            degree = int(shard.get("degree", 1))
+            replicas = int(step.get("replicas") or 1)
+            devs = 0
+            for group in step.get("queue_groups") or []:
+                if isinstance(group, dict):
+                    listed = group.get("devices",
+                                       group.get("gpus")) or []
+                    devs += (len(listed) if isinstance(listed, list)
+                             else 0)
+            if devs and degree * replicas > devs:
+                problems.append(
+                    "pipeline step %d declares shard degree %d x %d "
+                    "replica(s) but lists only %d device(s) — the "
+                    "ring exceeds the step's device budget"
+                    % (step_idx, degree, replicas, devs))
+            d = detail.get(str(step_idx))
+            if d is not None and int(d.get("degree", 0)) != degree:
+                problems.append(
+                    "'Shard steps:' says step %d ran degree %s but "
+                    "the config declares %d"
+                    % (step_idx, d.get("degree"), degree))
+        break
+    # collective-tax nesting: only checkable on trace-enabled runs
+    # whose artifact is complete (dropped events undercount both sides)
+    trace_path = os.path.join(job_dir, "trace.json")
+    if not os.path.isfile(trace_path) or meta.get("trace_dropped", 0):
+        return problems
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)
+    except ValueError:
+        return problems  # _check_trace_artifact reports unreadability
+    coll_us: Dict[int, float] = {}
+    call_us: Dict[int, float] = {}
+    span_re = re.compile(r"exec(\d+)\.(collective|model_call)$")
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        m = span_re.match(str(ev.get("name", "")))
+        if not m:
+            continue
+        step = int(m.group(1))
+        bucket = coll_us if m.group(2) == "collective" else call_us
+        bucket[step] = bucket.get(step, 0.0) + float(ev.get("dur", 0.0))
+    for step_idx, us in sorted(coll_us.items()):
+        if us > call_us.get(step_idx, 0.0) + 1.0:
+            problems.append(
+                "step %d traced %.0f us of exec.collective spans but "
+                "only %.0f us of model_call spans — the merge must "
+                "nest inside the inference span it rides"
+                % (step_idx, us, call_us.get(step_idx, 0.0)))
     return problems
 
 

@@ -116,6 +116,64 @@ def test_segments_exceeding_ring_slots_rejected():
     assert pc.steps[0].num_segments == 3
 
 
+def test_shard_key_expands_to_group_kwargs():
+    raw = _minimal(step1={"shard": {"degree": 2,
+                                    "hbm_budget_mb": 256}})
+    raw["pipeline"][1]["queue_groups"][0]["devices"] = [1, 2]
+    pc = parse_config(raw)
+    # one primary device -> ONE executor instance; the full ring rides
+    # the open kwargs passthrough to the stage constructor
+    assert pc.steps[1].groups[0].devices == [DeviceSpec(1)]
+    kw = pc.steps[1].kwargs_for_group(0)
+    assert kw["shard_devices"] == [1, 2]
+    assert kw["shard_degree"] == 2
+    assert kw["shard_axis"] == "tp"
+    assert kw["shard_hbm_budget_mb"] == 256
+    # config.raw keeps the as-written form (the job-dir copy)
+    assert raw["pipeline"][1]["queue_groups"][0]["devices"] == [1, 2]
+    assert "shard_devices" not in raw["pipeline"][1]["queue_groups"][0]
+
+
+def test_shard_composes_replica_major_with_replicas():
+    # replicas: 2 carves [1,2,3,4] into two lanes first, then each
+    # lane's sub-mesh is one degree-2 shard ring
+    raw = _minimal(step1={"replicas": 2, "shard": {"degree": 2}})
+    raw["pipeline"][1]["queue_groups"][0]["devices"] = [1, 2, 3, 4]
+    pc = parse_config(raw)
+    groups = pc.steps[1].groups
+    assert len(groups) == 2
+    rings = [pc.steps[1].kwargs_for_group(i)["shard_devices"]
+             for i in range(2)]
+    assert rings == [[1, 2], [3, 4]]
+    assert [g.devices for g in groups] == [[DeviceSpec(1)],
+                                           [DeviceSpec(3)]]
+
+
+def test_shard_key_rejections():
+    with pytest.raises(ConfigError, match="must be an object"):
+        parse_config(_minimal(step1={"shard": 2}))
+    with pytest.raises(ConfigError, match="unknown key"):
+        parse_config(_minimal(step1={"shard": {"degree": 2,
+                                               "deg": 2}}))
+    with pytest.raises(ConfigError, match="positive integer"):
+        parse_config(_minimal(step1={"shard": {"degree": 0}}))
+    with pytest.raises(ConfigError, match="positive integer"):
+        parse_config(_minimal(step1={"shard": {"degree": True}}))
+    with pytest.raises(ConfigError, match="positive number"):
+        parse_config(_minimal(step1={"shard": {"degree": 2,
+                                               "hbm_budget_mb": 0}}))
+    # the lane's device list IS the ring: its length must equal degree
+    with pytest.raises(ConfigError, match="exactly that many"):
+        parse_config(_minimal(step1={"shard": {"degree": 2}}))
+    raw = _minimal(step1={"shard": {"degree": 2}})
+    raw["pipeline"][1]["queue_groups"][0]["devices"] = [1, -1]
+    with pytest.raises(ConfigError, match="host"):
+        parse_config(raw)
+    with pytest.raises(ConfigError, match="num_segments"):
+        parse_config(_minimal(step0={"shard": {"degree": 1},
+                                     "num_segments": 2}))
+
+
 def test_all_shipped_configs_parse_and_resolve():
     for path in sorted(glob.glob(os.path.join(REPO_ROOT, "configs",
                                               "*.json"))):

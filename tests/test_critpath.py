@@ -277,6 +277,74 @@ def test_whatif_pool_rows_scales_dispatches():
     assert answer["vps_ratio"] == pytest.approx(2.0, rel=0.01)
 
 
+def test_whatif_shard_degree_rescales_only_the_collective_slice():
+    # calibrated at degree 2: 2000 ms service of which 800 ms is the
+    # measured merge collective. g(2)=1/2, g(4)=3/4, g(1)=0, so
+    # degree 4 predicts 2000 - 800 + 800*1.5 = 2400 ms and degree 1
+    # sheds the whole slice: 1200 ms. Compute never rescales.
+    stage = StageCalib(step=1, lanes=1, dispatches=12,
+                       service_ms=2000.0, collective_ms=800.0,
+                       shard_degree=2)
+    model = WhatIfModel([stage], requests=12, wall_s=24.0)
+    up = model.query({"shard_degree": {"step1": 4}})
+    assert up["vps_ratio"] == pytest.approx(2000.0 / 2400.0, rel=0.01)
+    down = model.query({"shard_degree": {1: 1}})
+    assert down["vps_ratio"] == pytest.approx(2000.0 / 1200.0,
+                                              rel=0.01)
+    # same degree is the identity
+    same = model.query({"shard_degree": {"step1": 2}})
+    assert same["vps_ratio"] == pytest.approx(1.0, rel=1e-6)
+
+
+def test_whatif_shard_degree_from_degree_one_predicts_no_tax():
+    # a degree-1 calibration measured NO collective: the model
+    # honestly predicts no tax instead of inventing one (documented —
+    # validate degree-1 -> k predictions against an executed arm)
+    stage = StageCalib(step=0, lanes=1, dispatches=10,
+                       service_ms=1000.0, collective_ms=0.0,
+                       shard_degree=1)
+    model = WhatIfModel([stage], requests=10, wall_s=10.0)
+    answer = model.query({"shard_degree": {"step0": 4}})
+    assert answer["vps_ratio"] == pytest.approx(1.0, rel=1e-9)
+
+
+def test_steps_info_counts_shard_ring_as_one_lane():
+    # the as-written device list of a sharded step carries
+    # replicas x degree entries, but a ring is ONE executable: lanes
+    # must come out as replicas, not devices
+    info = steps_info_from_config({"pipeline": [
+        {"queue_groups": [{"devices": [0, 1], "out_queues": [0]}],
+         "shard": {"degree": 2}},
+        {"queue_groups": [{"devices": [2, 3], "in_queue": 0}],
+         "shard": {"degree": 2}}]})
+    assert info[0]["lanes"] == 1 and info[0]["shard_degree"] == 2
+    assert info[1]["lanes"] == 1 and info[1]["shard_degree"] == 2
+
+
+def test_calibrate_parses_collective_span_without_double_count():
+    from rnb_tpu.metrics import hist_bucket, HIST_NUM_BUCKETS
+    buckets = [0] * HIST_NUM_BUCKETS
+    buckets[hist_bucket(2000.0)] = 10
+    snapshot = {
+        "counters": {"slo.tracked": 10}, "gauges": {}, "rates": {},
+        "histograms": {
+            "exec1.model_call": {"count": 10, "sum_ms": 20000.0,
+                                 "buckets": buckets},
+            # the merge span NESTS inside model_call: it calibrates
+            # collective_ms but must NOT be added to service_ms
+            "exec1.collective": {"count": 10, "sum_ms": 5000.0,
+                                 "buckets": buckets},
+        },
+    }
+    info = {1: {"lanes": 1, "injected_ms": 0.0, "rows_cap": None,
+                "shard_degree": 2}}
+    model = calibrate_from_snapshot(snapshot, info, wall_s=30.0)
+    [stage] = model.stages
+    assert stage.service_ms == pytest.approx(2000.0)
+    assert stage.collective_ms == pytest.approx(500.0)
+    assert stage.shard_degree == 2
+
+
 def test_whatif_calibrate_from_snapshot_and_counters():
     from rnb_tpu.metrics import hist_bucket, HIST_NUM_BUCKETS
     buckets = [0] * HIST_NUM_BUCKETS
@@ -299,7 +367,7 @@ def test_whatif_calibrate_from_snapshot_and_counters():
         "ragged": {"pool_rows": 3}}
     info = steps_info_from_config(raw)
     assert info[1] == {"lanes": 2, "injected_ms": 2000.0,
-                       "rows_cap": 3}
+                       "rows_cap": 3, "shard_degree": 1}
     # the 'gpus' alias counts lanes exactly like 'devices'
     alias = steps_info_from_config(
         {"pipeline": [{"queue_groups": [{"gpus": [0, 1, 2]}]}]})

@@ -98,6 +98,75 @@ def test_dma_gate_is_off_on_cpu():
     assert dma_available() is False
 
 
+def test_ring_all_gather_twin_is_bitwise_at_degrees_1_2_4():
+    # the reduction twin the sharded stages ride: gathered result must
+    # be bitwise the unsharded array on every core, at every degree
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from rnb_tpu.ops.handoff_dma import ring_all_gather
+    devs = _devices()
+    rng = np.random.default_rng(7)
+    full = jnp.asarray(
+        rng.standard_normal((3, 8)).astype(np.float32))
+    for n in (1, 2, 4):
+        mesh = Mesh(np.array(devs[:n]), ("tp",))
+        x = jax.device_put(
+            full, NamedSharding(mesh, PartitionSpec(None, "tp")))
+        out = ring_all_gather(x, mesh, use_pallas=False)
+        assert np.array_equal(np.asarray(out), np.asarray(full))
+
+
+def test_ring_all_gather_rejects_non_divisible():
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from rnb_tpu.ops.handoff_dma import ring_all_gather
+    devs = _devices()
+    mesh = Mesh(np.array(devs[:4]), ("tp",))
+    x = jnp.zeros((2, 6), jnp.float32)  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_all_gather(x, mesh, use_pallas=False)
+
+
+def test_ring_psum_scatter_twin_matches_sum_at_degrees_1_2_4():
+    # stacked (n, ...) operands -> concatenated per-core sum chunks ==
+    # the full elementwise sum; integer-valued float32 keeps the
+    # ring-order association exact, so the match is bitwise
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from rnb_tpu.ops.handoff_dma import ring_psum_scatter
+    devs = _devices()
+    rng = np.random.default_rng(11)
+    for n in (1, 2, 4):
+        mesh = Mesh(np.array(devs[:n]), ("tp",))
+        stack = jnp.asarray(
+            rng.integers(-8, 9, size=(n, 2, 8)).astype(np.float32))
+        out = ring_psum_scatter(stack, mesh, use_pallas=False)
+        want = np.asarray(stack).sum(axis=0)
+        assert out.shape == want.shape
+        assert np.array_equal(np.asarray(out), want)
+
+
+def test_ring_psum_scatter_rejects_bad_shapes():
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from rnb_tpu.ops.handoff_dma import ring_psum_scatter
+    devs = _devices()
+    mesh = Mesh(np.array(devs[:2]), ("tp",))
+    # leading axis must carry one operand per ring member
+    with pytest.raises(ValueError, match="leading axis"):
+        ring_psum_scatter(jnp.zeros((3, 2, 8), jnp.float32), mesh,
+                          use_pallas=False)
+    # the scattered operand axis must divide over the ring
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_psum_scatter(jnp.zeros((2, 2, 7), jnp.float32), mesh,
+                          use_pallas=False)
+
+
 # -- EdgeHandoff take rules -------------------------------------------
 
 def _settings(mode):
@@ -315,6 +384,83 @@ def test_build_report_predicts_executed_plan_occupancy():
     assert report["plan"]["step1"]["replicas"] \
         >= report["plan"]["step0"]["replicas"]
     assert build_report([], 10.0, 8, "plan") is None
+
+
+def test_ring_hop_factor_and_service_at_degree():
+    from rnb_tpu.placement import ring_hop_factor, service_at_degree
+    assert ring_hop_factor(1) == 0.0
+    assert ring_hop_factor(2) == pytest.approx(0.5)
+    assert ring_hop_factor(4) == pytest.approx(0.75)
+    # measured at degree 2: service 10s of which 4s is collective ->
+    # compute slice 6s is degree-invariant, collective scales by
+    # g(k)/g(2)
+    assert service_at_degree(10.0, 4.0, 2, 2) == pytest.approx(10.0)
+    assert service_at_degree(10.0, 4.0, 2, 4) \
+        == pytest.approx(6.0 + 4.0 * 0.75 / 0.5)
+    assert service_at_degree(10.0, 4.0, 2, 1) == pytest.approx(6.0)
+    # a degree-1 measurement saw NO collective: refusing to invent a
+    # tax is the corrected service model, not a gap
+    assert service_at_degree(10.0, 0.0, 1, 2) is None
+    assert service_at_degree(10.0, 0.0, 1, 1) == pytest.approx(10.0)
+
+
+def test_recommend_joint_hand_computed_two_dimensional_plan():
+    from rnb_tpu.placement import recommend_joint
+    # step 0: measured at degree 2, memory floor binds (min_degree 2)
+    #   -> keeps degree 2 at its full measured load 0.8
+    # step 1: measured at degree 2 but floor is 1 -> drops to degree 1
+    #   shedding the measured collective slice: load 0.6 - 0.2 = 0.4
+    plan = recommend_joint({0: 0.8, 1: 0.6}, device_budget=8,
+                           degrees={0: 2, 1: 2},
+                           collective_loads={0: 0.2, 1: 0.2},
+                           min_degrees={0: 2, 1: 1})
+    assert plan[1]["shard_degree"] == 1
+    assert plan[1]["load"] == pytest.approx(0.4)
+    assert plan[0]["shard_degree"] == 2
+    assert plan[0]["load"] == pytest.approx(0.8)
+    # greedy trace on these numbers: base rings cost 2+1=5 spare;
+    # s0 (.8) takes two more rings (per-replica .8 -> .4 -> .267),
+    # then s1 (.4) beats .267 and takes the last device
+    assert plan[0]["replicas"] == 3
+    assert plan[1]["replicas"] == 2
+    assert sum(p["replicas"] * p["shard_degree"]
+               for p in plan.values()) == 8
+
+
+def test_recommend_joint_skips_ring_too_big_for_spare_budget():
+    from rnb_tpu.placement import recommend_joint
+    # the hottest step's ring (4 devices) exceeds the 1 spare device:
+    # the budget goes to the next-hottest instead of being stranded
+    plan = recommend_joint({0: 0.9, 1: 0.1}, device_budget=6,
+                           degrees={0: 4, 1: 1},
+                           collective_loads={0: 0.3, 1: 0.0},
+                           min_degrees={0: 4, 1: 1})
+    assert plan[0] == {"replicas": 1, "shard_degree": 4, "load": 0.9}
+    assert plan[1]["replicas"] == 2
+
+
+def test_build_report_shard_rows_and_joint_plan():
+    from rnb_tpu.placement import CostRecord, build_report
+    records = [
+        # step 0: unsharded loader
+        CostRecord(0, 2.0, 10),
+        # step 1: degree-2 stage, 1s of its 4s busy is merge gathers,
+        # armed gate proved degree 2 is its memory floor
+        CostRecord(1, 4.0, 10, shard_degree=2, collective_s=1.0,
+                   min_degree=2),
+    ]
+    report = build_report(records, wall_s=10.0, device_budget=6,
+                          mode="plan")
+    s1 = report["steps"]["step1"]
+    assert s1["shard_degree"] == 2
+    # collective_ms is the per-dispatch slice OF service_ms
+    assert s1["collective_ms"] == pytest.approx(100.0)
+    assert s1["service_ms"] == pytest.approx(400.0)
+    assert "shard_degree" not in report["steps"]["step0"]
+    # the joint plan keeps the floor-bound ring and reports degree
+    p1 = report["plan"]["step1"]
+    assert p1["shard_degree"] == 2 and p1["replicas"] >= 1
+    assert report["plan"]["step0"]["shard_degree"] == 1
 
 
 # -- end-to-end: replicas + handoff + placement -----------------------

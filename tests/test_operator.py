@@ -277,6 +277,18 @@ def test_parse_whatif_query():
         parse_whatif_query("replicas_step2=+1")
 
 
+def test_parse_whatif_query_shard_degree():
+    spec = parse_whatif_query("shard_degree_step1=4&replicas_step0=2")
+    assert spec == {"replicas": {"step0": 2},
+                    "shard_degree": {"step1": 4}}
+    # a degree below 1 is not a counterfactual anyone ran
+    with pytest.raises(ValueError):
+        parse_whatif_query("shard_degree_step1=0")
+    # the unknown-key message teaches the new vocabulary
+    with pytest.raises(ValueError, match="shard_degree_step"):
+        parse_whatif_query("shard_degree=2")
+
+
 def _calibratable_registry():
     reg = MetricsRegistry(MetricsSettings(), job_dir=None)
     for _ in range(20):

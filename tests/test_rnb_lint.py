@@ -41,6 +41,8 @@ GRAPH_CASES = [
     ("bad_g008_dtype.json", "RNB-G008"),
     ("bad_g008_dct.json", "RNB-G008"),
     ("bad_g009_ragged.json", "RNB-G009"),
+    ("bad_g010_degree.json", "RNB-G010"),
+    ("bad_g010_no_spec.json", "RNB-G010"),
 ]
 
 
@@ -66,6 +68,16 @@ def test_good_dct_fixture_is_clean():
     # stages
     from rnb_tpu.analysis.graph import check_config
     findings = check_config(_fixture("good_dct.json"))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_good_shard_fixture_is_clean():
+    # degree 2 divides every declared channel width of [1..5] and the
+    # ring is 2 distinct devices on a SUPPORTS_SHARD class — nothing
+    # fires (in particular no RNB-G005: the parse-time shard_* wiring
+    # keys are not user config typos)
+    from rnb_tpu.analysis.graph import check_config
+    findings = check_config(_fixture("good_shard.json"))
     assert findings == [], [f.render() for f in findings]
 
 
@@ -429,6 +441,8 @@ def test_unregistered_meta_line_triggers_t004(tmp_path):
                      'f.write("Net: frames_sent=%d\\n" % nt)\n'
                      'f.write("Net errors: total=%d\\n" % ne)\n'
                      'f.write("Pages: allocs=%d\\n" % pg)\n'
+                     'f.write("Shard: steps=%d\\n" % sh)\n'
+                     'f.write("Shard steps: %s\\n" % ss)\n'
                      'f.write("Locks: tracked=%d\\n" % lk)\n'
                      'f.write("Lock edges: %s\\n" % le)\n'
                      'f.write("Bogus line: %s\\n" % b)\n')
@@ -502,6 +516,8 @@ REPO_BENCH_LIKE = (
         'open_before_timeout=%d\\n" % nt)\n'
         'f.write("Net errors: total=%d refused=%d reset=%d '
         'timeout=%d partial_frame=%d corrupt=%d\\n" % ne)\n'
+        'f.write("Shard: steps=%d max_degree=%d gathers=%d '
+        'collective_us=%d rows=%d\\n" % sh)\n'
         'f.write("Locks: tracked=%d acquires=%d edges=%d '
         'violations=%d\\n" % lk)\n')
 

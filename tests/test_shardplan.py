@@ -1,0 +1,242 @@
+"""Intra-stage tensor parallelism (rnb_tpu/parallel/shardplan.py).
+
+Contract under test, on the 8-virtual-device CPU backend:
+
+  * the weight-gathered sharded forward is logit-BIT-identical to the
+    unsharded forward at degrees 2 and 4, on both production pixel
+    paths (yuv420 + dct), padded and whole-pool ragged dispatch, with
+    exactly ONE compiled signature per stage per arm;
+  * a head stage's merge collective is host-timed into shard_stats
+    (gathers / collective_ms / rows foot the calls), a mid-pipeline
+    range needs no merge at all;
+  * the plan math — sharded-vs-replicated byte split, the per-device
+    HBM projection, the min feasible degree — and the launch-time
+    gates: over-budget projection REJECTS construction, invalid
+    degrees / device rings / chunked-ragged combinations are refused
+    up front, never discovered mid-run.
+"""
+
+import numpy as np
+import pytest
+
+from rnb_tpu.stage import PaddedBatch, RaggedBatch
+from rnb_tpu.telemetry import TimeCard
+
+LS = (1, 1, 1, 1)  # minimal layer sizes: fast compile, full topology
+
+
+# -- plan math --------------------------------------------------------
+
+def test_shardable_widths_and_validate_degree():
+    from rnb_tpu.parallel.shardplan import (shardable_widths,
+                                            validate_degree)
+    # the full range ends the network, so the head rides along
+    assert shardable_widths(1, 5, 8) == [64, 64, 128, 256, 512, 8]
+    # a mid-pipeline range has no head column axis
+    assert shardable_widths(2, 4, 400) == [64, 128, 256]
+    validate_degree(4, 1, 5, 8)
+    validate_degree(1, 1, 5, 400)
+    with pytest.raises(ValueError, match="does not divide"):
+        validate_degree(3, 1, 5, 8)  # 64 % 3
+    with pytest.raises(ValueError, match="does not divide"):
+        validate_degree(16, 1, 5, 8)  # classes 8 % 16
+    with pytest.raises(ValueError, match=">= 1"):
+        validate_degree(0, 1, 5, 8)
+
+
+def test_is_sharded_param_picks_temporal_and_head_only():
+    from rnb_tpu.parallel.shardplan import is_sharded_param
+    assert is_sharded_param(("layer1", "block0", "temporal", "kernel"))
+    assert is_sharded_param(("classifier", "linear", "kernel"))
+    assert is_sharded_param(("classifier", "linear", "bias"))
+    assert not is_sharded_param(("layer1", "block0", "spatial",
+                                 "kernel"))
+    assert not is_sharded_param(("layer1", "block0", "temporal",
+                                 "bias"))
+    assert not is_sharded_param(("bn", "scale"))
+
+
+def test_split_bytes_projection_and_min_degree():
+    from rnb_tpu.parallel.shardplan import (min_feasible_degree,
+                                            projected_device_mb,
+                                            split_param_bytes)
+    variables = {"params": {
+        "temporal": {"kernel": np.zeros((3, 4, 8), np.float32)},
+        "spatial": {"kernel": np.zeros((3, 3, 4), np.float32)},
+        "linear": {"kernel": np.zeros((8, 8), np.float32),
+                   "bias": np.zeros((8,), np.float32)}}}
+    rep, sh = split_param_bytes(variables)
+    assert sh == (3 * 4 * 8 + 8 * 8 + 8) * 4
+    assert rep == 3 * 3 * 4 * 4
+    # one formula for gate and planner: replicated + sharded/k + pool
+    mib = 1 << 20
+    assert projected_device_mb(2 * mib, 8 * mib, mib, 1) \
+        == pytest.approx(11.0)
+    assert projected_device_mb(2 * mib, 8 * mib, mib, 4) \
+        == pytest.approx(5.0)
+    # 11 MiB at d1, 7 at d2, 5 at d4: a 6 MiB budget first fits at 4
+    assert min_feasible_degree(2 * mib, 8 * mib, mib, 6.0) == 4
+    assert min_feasible_degree(2 * mib, 8 * mib, mib, 7.0) == 2
+    assert min_feasible_degree(2 * mib, 8 * mib, mib, 64.0) == 1
+    # the replicated half alone exceeds the budget: NO degree saves it
+    assert min_feasible_degree(2 * mib, 8 * mib, mib, 2.5) is None
+
+
+def test_build_shard_mesh_wants_exactly_degree_devices():
+    import jax
+    from rnb_tpu.parallel.shardplan import build_shard_mesh
+    devs = jax.devices()
+    mesh = build_shard_mesh(devs[:2], 2)
+    assert int(mesh.shape["tp"]) == 2
+    with pytest.raises(ValueError, match="exactly degree"):
+        build_shard_mesh(devs[:3], 2)
+
+
+# -- golden-logit bit parity ------------------------------------------
+
+def _runner(pixel_path, **extra):
+    import jax
+    from rnb_tpu.models.r2p1d.model import R2P1DRunner
+    kw = dict(start_index=1, end_index=5, num_classes=8,
+              layer_sizes=LS, max_rows=2, consecutive_frames=2,
+              num_warmups=1, pixel_path=pixel_path)
+    kw.update(extra)
+    return R2P1DRunner(jax.devices()[0], **kw)
+
+
+def _yuv_pool(rows=2, seed=13):
+    from rnb_tpu.ops.yuv import packed_frame_bytes
+    pk = packed_frame_bytes(112, 112)
+    return np.random.RandomState(seed).randint(
+        0, 256, (rows, 2, pk), np.uint8)
+
+
+def _dct_pool(rows=2):
+    from rnb_tpu.decode import SyntheticDecoder
+    return SyntheticDecoder().decode_clips_dct(
+        "synth://shard-parity", list(range(0, 8 * rows, 8)), 2,
+        112, 112)
+
+
+@pytest.mark.parametrize("pixel_path", ["yuv420", "dct"])
+def test_sharded_forward_is_bitwise_unsharded_both_pixel_paths(
+        pixel_path):
+    import jax.numpy as jnp
+    pool = _yuv_pool() if pixel_path == "yuv420" else _dct_pool()
+    base = _runner(pixel_path)
+    (want,), _, _ = base((PaddedBatch(jnp.asarray(pool), 2),), None,
+                         TimeCard(0))
+    for degree in (2, 4):
+        sharded = _runner(pixel_path, shard_degree=degree)
+        sharded.bind_shard_step(1)
+        (got,), _, _ = sharded((PaddedBatch(jnp.asarray(pool), 2),),
+                               None, TimeCard(1))
+        # BIT-identical: the gathered kernel is bitwise the unsharded
+        # one and the op graph is structurally identical, so XLA's
+        # bf16 excess-precision elisions land in the same places
+        assert np.array_equal(np.asarray(got.data),
+                              np.asarray(want.data)), \
+            (pixel_path, degree)
+        # the merge collective was host-timed into the accounting
+        stats = sharded.shard_stats
+        assert stats["gathers"] == 1
+        assert stats["collective_ms"] > 0.0
+        assert stats["rows"] == 2
+        # one compiled signature per stage per arm: the parity call
+        # above reused the warmup executable, and a repeat adds none
+        sharded.compiles.freeze()
+        sharded((PaddedBatch(jnp.asarray(pool), 2),), None,
+                TimeCard(2))
+        snap = sharded.compiles.snapshot()
+        assert snap["warmup"] == 1 and snap["steady_new"] == 0
+
+
+def test_sharded_ragged_whole_pool_is_bitwise_unsharded():
+    import jax.numpy as jnp
+    pool = _yuv_pool(rows=2, seed=17)
+    # the unsharded twin must pin chunk 0 (whole-pool apply): chunked
+    # dispatch changes the op graph and is NOT bitwise-comparable
+    base = _runner("yuv420", ragged=True, ragged_pool_rows=2,
+                   ragged_chunk_rows=0)
+    sharded = _runner("yuv420", ragged=True, ragged_pool_rows=2,
+                      shard_degree=2)
+    assert sharded.ragged_chunk_rows == 0  # auto-chunk collapsed
+    for valid in (1, 2):
+        (want,), _, _ = base(
+            (RaggedBatch(jnp.asarray(pool), valid, (0, valid)),),
+            None, TimeCard(0))
+        (got,), _, _ = sharded(
+            (RaggedBatch(jnp.asarray(pool), valid, (0, valid)),),
+            None, TimeCard(1))
+        assert isinstance(got, RaggedBatch)
+        assert np.array_equal(np.asarray(got.data)[:valid],
+                              np.asarray(want.data)[:valid]), valid
+    # the ragged pool is ONE signature regardless of valid
+    sharded.compiles.freeze()
+    sharded((RaggedBatch(jnp.asarray(pool), 2, (0, 2)),), None,
+            TimeCard(2))
+    snap = sharded.compiles.snapshot()
+    assert snap["warmup"] == 1 and snap["steady_new"] == 0
+
+
+def test_mid_pipeline_shard_has_no_merge_and_matches():
+    import jax.numpy as jnp
+    pool = _yuv_pool(rows=2, seed=19)
+    base = _runner("yuv420", end_index=4)
+    sharded = _runner("yuv420", end_index=4, shard_degree=2)
+    # no head -> the last temporal gather already reassembled the
+    # activation: nothing left to merge, nothing to host-time
+    assert sharded._merge is None
+    sharded.bind_shard_step(1)  # protocol call is a no-op here
+    (want,), _, _ = base((PaddedBatch(jnp.asarray(pool), 2),), None,
+                         TimeCard(0))
+    (got,), _, _ = sharded((PaddedBatch(jnp.asarray(pool), 2),), None,
+                           TimeCard(1))
+    assert np.array_equal(np.asarray(got.data), np.asarray(want.data))
+    assert sharded.shard_stats["gathers"] == 0
+
+
+# -- launch-time gates ------------------------------------------------
+
+def test_over_budget_projection_rejects_launch():
+    from rnb_tpu.parallel.shardplan import (projected_device_mb,
+                                            split_param_bytes)
+    with pytest.raises(ValueError, match="shard launch rejected"):
+        _runner("yuv420", shard_degree=2, shard_hbm_budget_mb=0.001)
+    # a budget between the d1 and d2 projections: degree 1 is the
+    # headline's launch-rejected arm, degree 2 fits
+    probe = _runner("yuv420", shard_degree=2,
+                    shard_hbm_budget_mb=10_000.0)
+    stats = probe.shard_stats
+    rep, sh = stats["replicated_bytes"], stats["sharded_bytes"]
+    pool = stats["pool_bytes"]
+    d1 = projected_device_mb(rep, sh, pool, 1)
+    d2 = projected_device_mb(rep, sh, pool, 2)
+    assert d2 < d1
+    budget = (d1 + d2) / 2.0
+    with pytest.raises(ValueError, match="shard launch rejected"):
+        _runner("yuv420", shard_degree=1, shard_hbm_budget_mb=budget)
+    fits = _runner("yuv420", shard_degree=2,
+                   shard_hbm_budget_mb=budget)
+    assert fits.shard_stats["min_degree"] == 2
+    # the stats' byte split is the real variables tree's
+    assert (rep, sh) == split_param_bytes(fits._variables)
+
+
+def test_shard_construction_rejections():
+    import jax
+    with pytest.raises(ValueError, match="does not divide"):
+        _runner("yuv420", shard_degree=3)
+    with pytest.raises(ValueError, match="shard_degree must be"):
+        _runner("yuv420", shard_degree=0)
+    with pytest.raises(ValueError, match="exactly that many devices"):
+        _runner("yuv420", shard_degree=2,
+                shard_devices=[0, 1, 2])
+    with pytest.raises(ValueError, match="cannot be combined"):
+        _runner("yuv420", ragged=True, ragged_pool_rows=2,
+                ragged_chunk_rows=2, shard_degree=2)
+    # declared degree 1 arms the accounting without a mesh
+    one = _runner("yuv420", shard_degree=1)
+    assert one.shard_declared and one._shard_mesh is None
+    assert one.shard_stats["degree"] == 1
+    del jax

@@ -409,6 +409,87 @@ def test_paged_zipf_arm_ships_executed_and_beats_its_blob_twin():
         "before touching the floor" % ratio)
 
 
+def test_shard_arms_ship_executed_and_pin_the_feasibility_headline():
+    """The intra-stage sharding pair (PR 19) must land in BOTH
+    configs/ and the matrix with ok execution rows. The headline is a
+    FEASIBILITY claim, not a speed claim — weight-gathered sharding
+    never divides compute — so the pin is analytic: project the d2
+    arm's per-device bytes from the abstract parameter tree
+    (jax.eval_shape — no weight is ever materialized) plus the
+    declared ragged pool, and assert the shipped 120 MiB budget
+    strictly separates degree 1 (launch-rejected, ~129.6 MiB) from
+    degree 2 (runs, ~112.1 MiB). A checkpoint/pool-geometry change
+    that collapses the separation invalidates the headline and must
+    fail here, not silently rot in the config comments (`make shard`
+    asserts the reject + bit parity end-to-end on a reduced net)."""
+    rel = "configs/rnb-shard-d2.json"
+    base = "configs/rnb-shard-d1.json"
+    from rnb_tpu.config import load_config
+    for p in (rel, base):
+        assert os.path.exists(os.path.join(REPO, p)), p
+    cfg = load_config(os.path.join(REPO, rel))
+    base_cfg = load_config(os.path.join(REPO, base))
+    kw = cfg.steps[1].kwargs_for_group(0)
+    base_kw = base_cfg.steps[1].kwargs_for_group(0)
+    assert kw["shard_degree"] == 2
+    assert len(kw["shard_devices"]) == 2
+    budget = kw["shard_hbm_budget_mb"]
+    assert budget == 120.0
+    # the baseline arm declares degree 1 (telemetry armed, no mesh),
+    # ships WITHOUT the budget (it could not launch under it), and
+    # pins whole-pool apply — the only program shape the sharded arm
+    # is bitwise-comparable against
+    assert base_kw["shard_degree"] == 1
+    assert "shard_hbm_budget_mb" not in base_kw
+    assert base_kw["ragged_chunk_rows"] == 0
+    # same workload on both arms: the pair differs by the runner's
+    # devices + shard key alone
+    with open(os.path.join(REPO, rel)) as f:
+        rel_raw = json.load(f)
+    with open(os.path.join(REPO, base)) as f:
+        base_raw = json.load(f)
+    assert rel_raw["pipeline"][0] == base_raw["pipeline"][0]
+    assert rel_raw["ragged"] == base_raw["ragged"]
+    # the analytic feasibility pin: abstract init (eval_shape) of the
+    # shipped network -> split by the shard partitioning rule -> the
+    # per-device projection the launch gate enforces
+    import jax
+    import numpy as np
+    from rnb_tpu.models.r2p1d.network import (LAYER_INPUT_SHAPES,
+                                              R2Plus1DClassifier)
+    from rnb_tpu.ops.yuv import packed_frame_bytes
+    from rnb_tpu.parallel.shardplan import (min_feasible_degree,
+                                            projected_device_mb,
+                                            split_param_bytes)
+    model = R2Plus1DClassifier(
+        start=cfg.steps[1].kwargs_for_group(0).get("start_index", 1),
+        end=5, num_classes=400)
+    dummy = jax.ShapeDtypeStruct(
+        (1, 2, 14, 14, LAYER_INPUT_SHAPES[1][-1]), np.float32)
+    abstract = jax.eval_shape(
+        lambda k, x: model.init(k, x, train=False),
+        jax.random.key(0), dummy)
+    rep, sh = split_param_bytes(abstract)
+    pool_bytes = (rel_raw["ragged"]["pool_rows"] * 8
+                  * packed_frame_bytes(112, 112))
+    d1_mb = projected_device_mb(rep, sh, pool_bytes, 1)
+    d2_mb = projected_device_mb(rep, sh, pool_bytes, 2)
+    assert d2_mb <= budget < d1_mb, (
+        "the shipped 120 MiB budget no longer separates the arms "
+        "(d1 projects %.1f MiB, d2 %.1f) — the feasibility headline "
+        "is void; re-derive the budget from the current network"
+        % (d1_mb, d2_mb))
+    assert min_feasible_degree(rep, sh, pool_bytes, budget,
+                               (1, 2, 4)) == 2
+    with open(ARTIFACT) as f:
+        rows = {r["config"]: r for r in json.load(f)["configs"]}
+    for p in (rel, base):
+        assert p in rows and rows[p].get("ok"), (
+            "%s has no ok execution row — run "
+            "scripts/run_shipped_configs.py --only '%s'"
+            % (p, os.path.basename(p)))
+
+
 def test_every_executed_config_is_still_shipped():
     """The reverse direction: MULTICHIP_CONFIGS.json and configs/ stay
     in sync BOTH ways. A row for a config that no longer ships is a
